@@ -1,0 +1,318 @@
+"""MasterClient: the agent/worker-side control-plane client.
+
+Equivalent capability: reference dlrover/python/elastic_agent/
+master_client.py:50 — singleton client with retry, covering the full API:
+tasks/shards, rendezvous join/comm-world, network status, parallel config,
+heartbeats, kv-store, metrics, failure reports.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import NodeEnv, NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import RpcClient
+
+logger = get_logger(__name__)
+
+
+class MasterClient:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, master_addr: str, node_id: int, node_type: str):
+        self._addr = master_addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._rpc = RpcClient(master_addr)
+        self._host = socket.gethostname()
+        try:
+            self._host_ip = socket.gethostbyname(self._host)
+        except OSError:
+            self._host_ip = "127.0.0.1"
+
+    # ------------------------------------------------------------ plumbing
+
+    @property
+    def master_addr(self) -> str:
+        return self._addr
+
+    @property
+    def node_id(self) -> int:
+        return self._node_id
+
+    def _get(self, message, retries: int = 5):
+        return self._rpc.get(self._node_type, self._node_id, message, retries)
+
+    def _report(self, message, retries: int = 5) -> bool:
+        return self._rpc.report(
+            self._node_type, self._node_id, message, retries
+        )
+
+    def ping(self) -> bool:
+        return self._rpc.ping()
+
+    def close(self):
+        self._rpc.close()
+
+    # ------------------------------------------------------- data sharding
+
+    def report_dataset_shard_params(
+        self,
+        batch_size: int,
+        num_epochs: int,
+        dataset_size: int,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        dataset_name: str = "train",
+        task_type: str = "training",
+        storage_type: str = "",
+        dataset_type: str = "table",
+    ) -> bool:
+        return self._report(
+            msg.DatasetShardParams(
+                batch_size=batch_size,
+                num_epochs=num_epochs,
+                dataset_size=dataset_size,
+                shuffle=shuffle,
+                num_minibatches_per_shard=num_minibatches_per_shard,
+                dataset_name=dataset_name,
+                task_type=task_type,
+                storage_type=storage_type,
+                dataset_type=dataset_type,
+            )
+        )
+
+    def get_task(self, dataset_name: str) -> msg.Task:
+        task = self._get(msg.TaskRequest(dataset_name=dataset_name))
+        return task if task is not None else msg.Task()
+
+    def report_task_result(
+        self, dataset_name: str, task_id: int, err_message: str = ""
+    ) -> bool:
+        return self._report(
+            msg.TaskResult(
+                dataset_name=dataset_name,
+                task_id=task_id,
+                err_message=err_message,
+            )
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        ckpt = self._get(msg.ShardCheckpointRequest(dataset_name=dataset_name))
+        return ckpt.content if ckpt else ""
+
+    def report_shard_checkpoint(self, content: str) -> bool:
+        return self._report(msg.ShardCheckpoint(content=content))
+
+    # ----------------------------------------------------------- rendezvous
+
+    def join_rendezvous(
+        self, node_rank: int, local_world_size: int, rdzv_name: str
+    ) -> bool:
+        return self._report(
+            msg.JoinRendezvousRequest(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+                node_ip=self._host_ip,
+            )
+        )
+
+    def get_comm_world(self, rdzv_name: str, node_rank: int):
+        world: msg.CommWorld = self._get(
+            msg.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)
+        )
+        return world
+
+    def num_nodes_waiting(self, rdzv_name: str) -> int:
+        res: msg.WaitingNodeNum = self._get(
+            msg.WaitingNodeNumRequest(rdzv_name=rdzv_name)
+        )
+        return res.waiting_num if res else 0
+
+    # --------------------------------------------------- node health check
+
+    def report_node_check_result(
+        self, node_rank: int, normal: bool, elapsed: float
+    ) -> bool:
+        return self._report(
+            msg.NodeCheckResultRequest(
+                node_id=node_rank, normal=normal, elapsed_time=elapsed
+            )
+        )
+
+    def check_network_ready(self) -> msg.NetworkCheckResult:
+        return self._get(msg.NetworkReadyRequest())
+
+    def check_straggler(self) -> msg.NetworkCheckResult:
+        return self._get(msg.StragglerExistRequest())
+
+    def report_failure(
+        self, error_data: str, level: str, restart_count: int = 0
+    ) -> bool:
+        return self._report(
+            msg.NodeFailure(
+                node_id=self._node_id,
+                error_data=error_data,
+                level=level,
+                restart_count=restart_count,
+            )
+        )
+
+    # ------------------------------------------------- heartbeat & metrics
+
+    def report_heart_beat(self, timestamp=None) -> msg.HeartbeatResponse:
+        resp = self._get(
+            msg.HeartBeat(
+                node_id=self._node_id, timestamp=timestamp or time.time()
+            )
+        )
+        return resp if resp is not None else msg.HeartbeatResponse()
+
+    def report_used_resource(
+        self, cpu_percent: float, memory_mb: int, tpu_stats=None
+    ) -> bool:
+        return self._report(
+            msg.ResourceStats(
+                node_id=self._node_id,
+                cpu_percent=cpu_percent,
+                memory_mb=memory_mb,
+                tpu_stats=tpu_stats or [],
+            ),
+            retries=1,
+        )
+
+    def report_global_step(self, step: int, timestamp=None) -> bool:
+        return self._report(
+            msg.GlobalStep(
+                step=step, timestamp=timestamp or time.time()
+            ),
+            retries=1,
+        )
+
+    def report_node_meta(
+        self, node_rank: int, addr: str, tpu_chips: int = 0
+    ) -> bool:
+        return self._report(
+            msg.NodeMeta(
+                node_type=self._node_type,
+                node_id=self._node_id,
+                node_rank=node_rank,
+                addr=addr,
+                tpu_chips=tpu_chips,
+            )
+        )
+
+    # -------------------------------------------------------------- config
+
+    def get_paral_config(self) -> msg.ParallelConfig:
+        return self._get(msg.ParallelConfigRequest())
+
+    def get_elastic_run_config(self) -> dict:
+        res: msg.ElasticRunConfig = self._get(msg.ElasticRunConfigRequest())
+        return res.configs if res else {}
+
+    # ------------------------------------------------------------ kv store
+
+    def kv_store_set(self, key: str, value: bytes) -> bool:
+        return self._report(msg.KeyValuePair(key=key, value=value))
+
+    def kv_store_get(self, key: str) -> bytes:
+        pair: msg.KeyValuePair = self._get(msg.KeyValueGetRequest(key=key))
+        return pair.value if pair else b""
+
+    def kv_store_add(self, key: str, delta: int) -> int:
+        res: msg.KeyValueAddResult = self._get(
+            msg.KeyValueAddRequest(key=key, delta=delta)
+        )
+        return res.value if res else 0
+
+    # ----------------------------------------------------------- ckpt sync
+
+    def report_ckpt_ready(
+        self, step: int, group: str, world: int
+    ) -> bool:
+        return self._report(
+            msg.CheckpointReadyRequest(
+                node_id=self._node_id,
+                step=step,
+                group=group,
+                world=world,
+            )
+        )
+
+    def check_ckpt_barrier(self, step: int, group: str, world: int) -> bool:
+        res: msg.BarrierResponse = self._get(
+            msg.CheckpointReadyRequest(
+                node_id=self._node_id, step=step, group=group, world=world
+            )
+        )
+        return res.passed if res else False
+
+    def sync_checkpoint(self, step: int) -> bool:
+        return self._report(
+            msg.CheckpointSyncRequest(node_id=self._node_id, step=step)
+        )
+
+    # ------------------------------------------------------------ barriers
+
+    def join_sync(self, sync_name: str) -> bool:
+        return self._report(
+            msg.SyncJoin(
+                sync_name=sync_name,
+                node_id=self._node_id,
+                node_type=self._node_type,
+            )
+        )
+
+    def sync_finished(self, sync_name: str) -> bool:
+        return self._report(msg.SyncFinish(sync_name=sync_name))
+
+    def barrier(self, sync_name: str, notify: bool = False) -> bool:
+        res = self._get(
+            msg.SyncBarrierRequest(sync_name=sync_name, notify=notify)
+        )
+        return res.success if res else False
+
+    def report_job_end(self, success: bool, reason: str = "") -> bool:
+        return self._report(
+            msg.JobEnd(node_id=self._node_id, success=success, reason=reason)
+        )
+
+    # ---------------------------------------------------------- singleton
+
+    @classmethod
+    def singleton_instance(cls) -> "MasterClient | None":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = build_master_client()
+        return cls._instance
+
+    @classmethod
+    def reset_singleton(cls, client: "MasterClient | None" = None):
+        with cls._instance_lock:
+            cls._instance = client
+
+
+def build_master_client(
+    master_addr: str | None = None, node_id: int | None = None
+) -> MasterClient | None:
+    """Build from env contract (reference master_client.py:408)."""
+    addr = master_addr or os.environ.get(NodeEnv.DLROVER_MASTER_ADDR, "")
+    if not addr:
+        return None
+    nid = (
+        node_id
+        if node_id is not None
+        else int(os.environ.get(NodeEnv.NODE_RANK, "0"))
+    )
+    node_type = os.environ.get(NodeEnv.NODE_TYPE, NodeType.WORKER)
+    return MasterClient(addr, nid, node_type)

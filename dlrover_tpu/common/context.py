@@ -1,0 +1,91 @@
+"""Process-wide singleton configuration context.
+
+Equivalent capability: reference dlrover/python/common/global_context.py:56
+(``Context`` singleton with tunable knobs the brain/master can override).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ConfigKeys:
+    TRAIN_SPEED_RECORD_NUM = "train_speed_record_num"
+    SECONDS_TO_START_AUTOSCALE_WORKER = "seconds_to_start_autoscale_worker"
+    STEP_TO_ADJUST_WORKER = "step_to_adjust_worker"
+    OPTIMIZE_WORKER_CPU_THRESHOLD = "optimize_worker_cpu_threshold"
+    SECONDS_INTERVAL_TO_OPTIMIZE = "seconds_interval_to_optimize"
+    FACTOR_TO_CUT_PENDING_CPU = "factor_to_cut_pending_cpu"
+    FACTOR_TO_CUT_PENDING_MEM = "factor_to_cut_pending_mem"
+    SECONDS_TO_WAIT_PENDING_POD = "seconds_to_wait_pending_pod"
+    SECONDS_HUGE_TRAINING_THRESHOLD = "seconds_huge_training_threshold"
+    GLOBAL_STEP_COUNT_TO_AUTO_WORKER = "global_step_count_to_auto_worker"
+    SECONDS_TO_CHANGE_PS = "seconds_to_change_ps"
+    SECONDS_TO_WAIT_FAILED_PS = "seconds_to_wait_failed_ps"
+    HANG_CPU_USAGE_RATE = "hang_cpu_usage_rate"
+    HANG_DETECTION_TIME_WINDOW = "hang_detection_time_window"
+
+
+class DefaultValues:
+    TRAIN_SPEED_RECORD_NUM = 50
+    SEC_TO_START_AUTOSCALE_WORKER = 90
+    STEP_TO_ADJUST_WORKER = 200
+    OPTIMIZED_WORKER_CPU_THRESHOLD = 20
+    SEC_INTERVAL_TO_OPTIMIZE = 300
+    FACTOR_TO_CUT_PENDING_CPU = 2
+    FACTOR_TO_CUT_PENDING_MEM = 2
+    SEC_TO_WAIT_PENDING_POD = 900
+    SEC_HUGE_TRAINING_THRESHOLD = 1800
+    STEP_SAMPLE_COUNT_TO_AUTO_WORKER = 5
+    SEC_TO_CHANGE_PS = 3600
+    SEC_TO_WAIT_FAILED_PS = 600
+    HANG_CPU_USAGE_RATE = 0.05
+    HANG_DETECTION_TIME_WINDOW = 1800
+
+
+class Context:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self.train_speed_record_num = DefaultValues.TRAIN_SPEED_RECORD_NUM
+        self.seconds_to_autoscale_worker = (
+            DefaultValues.SEC_TO_START_AUTOSCALE_WORKER
+        )
+        self.step_to_adjust_worker = DefaultValues.STEP_TO_ADJUST_WORKER
+        self.optimize_worker_cpu_threshold = (
+            DefaultValues.OPTIMIZED_WORKER_CPU_THRESHOLD
+        )
+        self.seconds_interval_to_optimize = (
+            DefaultValues.SEC_INTERVAL_TO_OPTIMIZE
+        )
+        self.seconds_to_wait_pending_pod = (
+            DefaultValues.SEC_TO_WAIT_PENDING_POD
+        )
+        self.sample_count_to_adjust_worker = (
+            DefaultValues.STEP_SAMPLE_COUNT_TO_AUTO_WORKER
+        )
+        self.hang_cpu_usage_percentage = DefaultValues.HANG_CPU_USAGE_RATE
+        self.hang_detection_time_window = (
+            DefaultValues.HANG_DETECTION_TIME_WINDOW
+        )
+        self.seconds_to_change_ps = DefaultValues.SEC_TO_CHANGE_PS
+        self.seconds_to_wait_failed_ps = DefaultValues.SEC_TO_WAIT_FAILED_PS
+        self.auto_worker_enabled = False
+        self.auto_ps_enabled = False
+        self.is_tfv1_ps = False
+        self.master_port: int | None = None
+        self.relaunch_always = False
+
+    def set_params_from_brain(self, overrides: dict):
+        for k, v in overrides.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+
+    @classmethod
+    def singleton_instance(cls) -> "Context":
+        if cls._instance is None:
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance

@@ -1,0 +1,433 @@
+"""Control-plane message dataclasses (the wire protocol).
+
+Equivalent capability: reference dlrover/python/common/grpc.py:129-450 —
+~45 pickled dataclass message types carried by a 2-RPC (report/get)
+protocol. Same two-verb shape here: every client interaction is either a
+``report`` (fire-and-ack) or a ``get`` (request-response).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Message:
+    """Base class: anything sent over the control plane."""
+
+
+# --------------------------------------------------------------------------
+# generic / envelope
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class BaseRequest(Message):
+    node_id: int = -1
+    node_type: str = ""
+    data: bytes = b""
+
+
+@dataclass
+class Response(Message):
+    success: bool = True
+    reason: str = ""
+
+
+# --------------------------------------------------------------------------
+# data sharding: tasks & shards
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Shard(Message):
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: list = field(default_factory=list)
+
+
+@dataclass
+class Task(Message):
+    task_id: int = -1
+    shard: Shard = field(default_factory=Shard)
+    task_type: str = ""
+
+    @property
+    def exists(self) -> bool:
+        return self.task_id >= 0
+
+
+@dataclass
+class TaskRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class TaskResult(Message):
+    dataset_name: str = ""
+    task_id: int = -1
+    err_message: str = ""
+
+
+@dataclass
+class DatasetShardParams(Message):
+    batch_size: int = 0
+    num_epochs: int = 1
+    dataset_size: int = 0
+    shuffle: bool = False
+    num_minibatches_per_shard: int = 2
+    dataset_name: str = ""
+    task_type: str = ""
+    storage_type: str = ""
+    dataset_type: str = "table"
+
+
+@dataclass
+class ShardCheckpointRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class ShardCheckpoint(Message):
+    content: str = ""
+
+
+@dataclass
+class DatasetTaskEnd(Message):
+    dataset_name: str = ""
+
+
+# --------------------------------------------------------------------------
+# rendezvous
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class JoinRendezvousRequest(Message):
+    node_id: int = 0
+    node_rank: int = 0
+    local_world_size: int = 1
+    rdzv_name: str = ""
+    node_ip: str = ""
+
+
+@dataclass
+class RendezvousState(Message):
+    round: int = 0
+    waiting_num: int = 0
+
+
+@dataclass
+class CommWorldRequest(Message):
+    node_id: int = 0
+    rdzv_name: str = ""
+
+
+@dataclass
+class CommWorld(Message):
+    """The assigned world for a rendezvous round.
+
+    ``world`` maps node_rank -> local_world_size. For the TPU backend the
+    master also designates the JAX coordination-service address
+    (rank-0 host) — this replaces the torch TCPStore bootstrap.
+    """
+
+    rdzv_name: str = ""
+    round: int = 0
+    group: int = 0
+    world: dict = field(default_factory=dict)
+    coordinator_addr: str = ""
+
+
+@dataclass
+class WaitingNodeNumRequest(Message):
+    node_id: int = 0
+    local_world_size: int = 1
+    rdzv_name: str = ""
+
+
+@dataclass
+class WaitingNodeNum(Message):
+    waiting_num: int = 0
+
+
+# --------------------------------------------------------------------------
+# node health / network (ICI/DCN mesh) check
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class NodeCheckResultRequest(Message):
+    """Per-node result of one device-mesh probe round (matmul + collective
+    timing). Equivalent of the reference report_network_status."""
+
+    node_id: int = 0
+    normal: bool = True
+    elapsed_time: float = 0.0
+    round: int = 0
+
+
+@dataclass
+class NetworkReadyRequest(Message):
+    pass
+
+
+@dataclass
+class NetworkCheckResult(Message):
+    normal: bool = True
+    reason: str = ""
+    nodes: list = field(default_factory=list)
+
+
+@dataclass
+class StragglerExistRequest(Message):
+    pass
+
+
+@dataclass
+class NodeFailure(Message):
+    node_id: int = 0
+    error_data: str = ""
+    level: str = ""
+    restart_count: int = 0
+
+
+# --------------------------------------------------------------------------
+# node lifecycle / heartbeat / resource stats
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class HeartBeat(Message):
+    node_id: int = 0
+    timestamp: float = 0.0
+
+
+@dataclass
+class HeartbeatResponse(Message):
+    action: str = ""  # "" | "restart" | "stop"
+
+
+@dataclass
+class TPUStats(Message):
+    index: int = 0
+    memory_used_gb: float = 0.0
+    memory_total_gb: float = 0.0
+    duty_cycle_pct: float = 0.0
+
+
+@dataclass
+class ResourceStats(Message):
+    node_id: int = 0
+    cpu_percent: float = 0.0
+    memory_mb: int = 0
+    tpu_stats: list = field(default_factory=list)
+
+
+@dataclass
+class NodeMeta(Message):
+    node_type: str = ""
+    node_id: int = 0
+    node_rank: int = -1
+    addr: str = ""
+    memory: int = 0
+    cpu: float = 0.0
+    tpu_chips: int = 0
+
+
+@dataclass
+class NodeEventMessage(Message):
+    node_type: str = ""
+    node_id: int = 0
+    event_type: str = ""
+    exit_reason: str = ""
+
+
+@dataclass
+class ClusterVersionRequest(Message):
+    task_type: str = ""
+    task_id: int = 0
+    version_type: str = ""
+
+
+@dataclass
+class ClusterVersion(Message):
+    version: int = 0
+
+
+# --------------------------------------------------------------------------
+# training progress / metrics
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class GlobalStep(Message):
+    timestamp: float = 0.0
+    step: int = 0
+
+
+@dataclass
+class DatasetMetric(Message):
+    dataset_name: str = ""
+    dataset_size: int = 0
+    batch_size: int = 0
+    epoch: int = 0
+
+
+@dataclass
+class ModelInfo(Message):
+    num_params: int = 0
+    flops_per_step: float = 0.0
+    hidden_size: int = 0
+    num_layers: int = 0
+    seq_len: int = 0
+
+
+# --------------------------------------------------------------------------
+# elasticity / parallel config
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class DataLoaderConfig(Message):
+    dataloader_name: str = ""
+    batch_size: int = 0
+    num_workers: int = 0
+    pin_memory: bool = False
+    version: int = 0
+
+
+@dataclass
+class OptimizerConfig(Message):
+    optimizer_name: str = ""
+    learning_rate: float = 0.0
+    version: int = 0
+
+
+@dataclass
+class ParallelConfigRequest(Message):
+    pass
+
+
+@dataclass
+class ParallelConfig(Message):
+    dataloader: DataLoaderConfig = field(default_factory=DataLoaderConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    restart: bool = False
+    # TPU: the mesh/sharding strategy the master asks workers to adopt on
+    # the next restart (serialized accel.Strategy), if any.
+    strategy: str = ""
+
+
+# --------------------------------------------------------------------------
+# checkpoint coordination
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class CheckpointSyncRequest(Message):
+    """Cross-node agreement that every agent persisted its shards of a
+    given step (reference servicer._sync_checkpoint :571)."""
+
+    node_id: int = 0
+    step: int = 0
+
+
+@dataclass
+class CheckpointReadyRequest(Message):
+    """Host-side all-rank-ready barrier before writing shm (replaces the
+    reference's device collective in engine.check_all_rank_ready :51)."""
+
+    node_id: int = 0
+    step: int = 0
+    ready: bool = True
+    group: str = "default"
+    world: int = 1
+
+
+@dataclass
+class BarrierResponse(Message):
+    passed: bool = False
+
+
+# --------------------------------------------------------------------------
+# kv-store (the rendezvous store the workers share)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class KeyValuePair(Message):
+    key: str = ""
+    value: bytes = b""
+
+
+@dataclass
+class KeyValueGetRequest(Message):
+    key: str = ""
+
+
+@dataclass
+class KeyValueAddRequest(Message):
+    key: str = ""
+    delta: int = 0
+
+
+@dataclass
+class KeyValueAddResult(Message):
+    value: int = 0
+
+
+# --------------------------------------------------------------------------
+# job control / sync service
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class SyncJoin(Message):
+    sync_name: str = ""
+    node_id: int = 0
+    node_type: str = ""
+
+
+@dataclass
+class SyncFinish(Message):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncBarrierRequest(Message):
+    sync_name: str = ""
+    notify: bool = False
+
+
+@dataclass
+class JobEnd(Message):
+    node_id: int = 0
+    success: bool = True
+    reason: str = ""
+
+
+@dataclass
+class ElasticRunConfigRequest(Message):
+    pass
+
+
+@dataclass
+class ElasticRunConfig(Message):
+    configs: dict = field(default_factory=dict)
+
+
+@dataclass
+class ScaleRequest(Message):
+    """Manual scale request (the ScalePlan-CR equivalent)."""
+
+    node_type: str = ""
+    count: int = 0
+
+
+@dataclass
+class DiagnosisReport(Message):
+    node_id: int = 0
+    content: str = ""
+    tag: str = ""

@@ -1,0 +1,220 @@
+"""Control-plane RPC: a 2-verb (report/get) length-prefixed TCP protocol.
+
+Equivalent capability: the reference's gRPC service with exactly two RPCs
+(dlrover/proto/elastic_training.proto:28-31 ``report``/``get``, server
+dlrover/python/master/servicer.py:62, client
+dlrover/python/elastic_agent/master_client.py:50). We keep the two-verb
+design but implement it over a plain threaded TCP socket server with
+length-prefixed frames and allowlisted-pickle payloads — no codegen, no
+external deps, and the same semantics: ``report`` returns a success ack,
+``get`` returns a message.
+
+Frame layout:  [u32 body_len][body]
+Body layout :  pickled tuple (verb, node_type, node_id, message)
+Response    :  pickled tuple (ok: bool, message_or_error)
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.serialize import deserialize_message, serialize_message
+
+logger = get_logger(__name__)
+
+_HDR = struct.Struct("<I")
+MAX_FRAME = 1 << 30
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, payload: bytes):
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    return _recv_exact(sock, length)
+
+
+class RpcService:
+    """Interface the server dispatches to (the master servicer implements
+    this)."""
+
+    def get(self, node_type: str, node_id: int, message):
+        raise NotImplementedError
+
+    def report(self, node_type: str, node_id: int, message) -> bool:
+        raise NotImplementedError
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        service: RpcService = self.server.service  # type: ignore[attr-defined]
+        while True:
+            try:
+                body = _recv_frame(sock)
+            except (ConnectionError, OSError):
+                return
+            try:
+                verb, node_type, node_id, message = deserialize_message(body)
+                if verb == "get":
+                    result = service.get(node_type, node_id, message)
+                    reply = (True, result)
+                elif verb == "report":
+                    ok = service.report(node_type, node_id, message)
+                    reply = (bool(ok), None)
+                elif verb == "ping":
+                    reply = (True, "pong")
+                else:
+                    reply = (False, f"unknown verb {verb!r}")
+            except Exception as e:  # noqa: BLE001 - fault barrier
+                logger.exception("rpc dispatch error")
+                reply = (False, f"{type(e).__name__}: {e}")
+            try:
+                _send_frame(sock, serialize_message(reply))
+            except (ConnectionError, OSError):
+                return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+    # Handler threads block in recv on idle client connections; never
+    # join them on close or shutdown hangs until every client disconnects.
+    block_on_close = False
+
+
+class RpcServer:
+    """Threaded control-plane server. One per master process."""
+
+    def __init__(self, port: int, service: RpcService, host: str = "0.0.0.0"):
+        self._server = _Server((host, port), _Handler)
+        self._server.service = service  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="dlrover-rpc-server",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self, grace=None):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """Persistent-connection client with reconnect + retry.
+
+    Mirrors the reference MasterClient retry decorator
+    (master_client.py:27 ``retry_grpc_request``).
+    """
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self._addr = addr
+        self._timeout = timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        host, _, port = self._addr.rpartition(":")
+        sock = socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=self._timeout
+        )
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+
+    def close(self):
+        with self._lock:
+            self._close_nolock()
+
+    def _close_nolock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _call_once(self, body: bytes):
+        if self._sock is None:
+            self._connect()
+        assert self._sock is not None
+        _send_frame(self._sock, body)
+        return deserialize_message(_recv_frame(self._sock))
+
+    def call(self, verb: str, node_type: str, node_id: int, message, retries=3):
+        body = serialize_message((verb, node_type, node_id, message))
+        with self._lock:
+            last_err: Exception | None = None
+            for attempt in range(retries):
+                try:
+                    ok, payload = self._call_once(body)
+                    if not ok and verb == "get":
+                        raise RuntimeError(f"rpc error: {payload}")
+                    return ok, payload
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    self._close_nolock()
+                    if attempt < retries - 1:
+                        time.sleep(min(2**attempt, 5))
+            raise ConnectionError(
+                f"rpc to {self._addr} failed after {retries} tries: {last_err}"
+            )
+
+    def get(self, node_type: str, node_id: int, message, retries: int = 3):
+        _, payload = self.call("get", node_type, node_id, message, retries)
+        return payload
+
+    def report(self, node_type: str, node_id: int, message, retries=3) -> bool:
+        ok, _ = self.call("report", node_type, node_id, message, retries)
+        return ok
+
+    def ping(self) -> bool:
+        try:
+            ok, payload = self.call("ping", "", -1, None, retries=1)
+            return ok and payload == "pong"
+        except Exception:  # noqa: BLE001
+            return False
+
+
+def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
+    """The reference telnet-checks the master before use
+    (elastic_run.py:258)."""
+    host, _, port = addr.rpartition(":")
+    try:
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=timeout
+        ):
+            return True
+    except OSError:
+        return False
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

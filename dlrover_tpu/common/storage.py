@@ -1,0 +1,152 @@
+"""Checkpoint storage backends + retention strategies.
+
+Equivalent capability: reference dlrover/python/common/storage.py
+(CheckpointStorage ABC :23, PosixDiskStorage :127,
+KeepStepIntervalStrategy :202, KeepLatestStepStrategy :230).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from abc import ABC, abstractmethod
+
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class CheckpointDeletionStrategy(ABC):
+    @abstractmethod
+    def clean_up(self, step: int, delete_func):
+        """Decide whether/which old step dirs to remove after ``step`` was
+        committed; call ``delete_func(dir)`` for each."""
+
+
+class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
+    """Keep only checkpoints whose step is a multiple of ``keep_interval``."""
+
+    def __init__(self, keep_interval: int, checkpoint_dir: str):
+        self._keep_interval = keep_interval
+        self._checkpoint_dir = checkpoint_dir
+        self._steps_to_clean: list[int] = []
+
+    def clean_up(self, step: int, delete_func):
+        if step % self._keep_interval == 0:
+            return
+        self._steps_to_clean.append(step)
+        while self._steps_to_clean:
+            rm_step = self._steps_to_clean.pop()
+            path = os.path.join(
+                self._checkpoint_dir,
+                f"{CheckpointConstant.STEP_DIR_PREFIX}{rm_step}",
+            )
+            try:
+                delete_func(path)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"fail to clean {path}: {e}")
+
+
+class KeepLatestStepStrategy(CheckpointDeletionStrategy):
+    """Keep at most ``max_to_keep`` newest step dirs."""
+
+    def __init__(self, max_to_keep: int, checkpoint_dir: str):
+        self._max_to_keep = max(max_to_keep, 1)
+        self._checkpoint_dir = checkpoint_dir
+        self._steps: list[int] = []
+
+    def clean_up(self, step: int, delete_func):
+        self._steps.append(step)
+        self._steps.sort()
+        while len(self._steps) > self._max_to_keep:
+            rm_step = self._steps.pop(0)
+            path = os.path.join(
+                self._checkpoint_dir,
+                f"{CheckpointConstant.STEP_DIR_PREFIX}{rm_step}",
+            )
+            try:
+                delete_func(path)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"fail to clean {path}: {e}")
+
+
+class CheckpointStorage(ABC):
+    """Byte/file-level storage used by the async saver daemon."""
+
+    @abstractmethod
+    def write(self, content, path: str):
+        ...
+
+    @abstractmethod
+    def read(self, path: str, mode: str = "r"):
+        ...
+
+    @abstractmethod
+    def safe_rmtree(self, dir_path: str):
+        ...
+
+    @abstractmethod
+    def safe_remove(self, path: str):
+        ...
+
+    @abstractmethod
+    def safe_makedirs(self, dir_path: str):
+        ...
+
+    @abstractmethod
+    def commit(self, step: int, success: bool):
+        ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool:
+        ...
+
+    @abstractmethod
+    def listdir(self, path: str) -> list[str]:
+        ...
+
+
+class PosixDiskStorage(CheckpointStorage):
+    def __init__(self, deletion_strategy=None):
+        self._deletion_strategy = deletion_strategy
+
+    def write(self, content, path: str):
+        mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read(self, path: str, mode: str = "r"):
+        if not os.path.exists(path):
+            return None
+        with open(path, mode) as f:
+            return f.read()
+
+    def safe_rmtree(self, dir_path: str):
+        shutil.rmtree(dir_path, ignore_errors=True)
+
+    def safe_remove(self, path: str):
+        if os.path.exists(path):
+            os.remove(path)
+
+    def safe_makedirs(self, dir_path: str):
+        os.makedirs(dir_path, exist_ok=True)
+
+    def commit(self, step: int, success: bool):
+        if self._deletion_strategy and success:
+            self._deletion_strategy.clean_up(step, self.safe_rmtree)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> list[str]:
+        return os.listdir(path) if os.path.isdir(path) else []
+
+
+def get_checkpoint_storage(deletion_strategy=None) -> CheckpointStorage:
+    return PosixDiskStorage(deletion_strategy)

@@ -1,0 +1,84 @@
+"""In-master KV store used as the workers' shared rendezvous store.
+
+Equivalent capability: reference master-side kv-store RPCs consumed by
+MasterKVStore (dlrover/python/elastic_agent/torch/master_kv_store.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class KVStoreService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: dict[str, bytes] = {}
+        self._cond = threading.Condition(self._lock)
+
+    def set(self, key: str, value: bytes):
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            return self._store.get(key, b"")
+
+    def add(self, key: str, delta: int) -> int:
+        """Atomic counter add (torch Store ``add`` semantics)."""
+        with self._cond:
+            current = int(self._store.get(key, b"0") or b"0")
+            current += delta
+            self._store[key] = str(current).encode()
+            self._cond.notify_all()
+            return current
+
+    def wait(self, keys: list[str], timeout: float = 300.0) -> bool:
+        deadline = time.time() + timeout
+        with self._cond:
+            while True:
+                if all(k in self._store for k in keys):
+                    return True
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 1.0))
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._store.pop(key, None) is not None
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()
+
+
+class SyncService:
+    """Named barriers across workers (reference sync_service.py:26)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sync_objs: dict[str, set] = {}
+        self._finished: set[str] = set()
+
+    def join_sync(self, sync_name: str, node_type: str, node_id: int) -> bool:
+        with self._lock:
+            self._sync_objs.setdefault(sync_name, set()).add(
+                (node_type, node_id)
+            )
+            return True
+
+    def sync_finished(self, sync_name: str) -> bool:
+        with self._lock:
+            return sync_name in self._finished
+
+    def notify_barrier(self, sync_name: str) -> bool:
+        with self._lock:
+            self._finished.add(sync_name)
+            return True
+
+    def remove_node(self, node_type: str, node_id: int):
+        with self._lock:
+            for members in self._sync_objs.values():
+                members.discard((node_type, node_id))

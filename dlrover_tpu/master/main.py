@@ -1,0 +1,74 @@
+"""Master process entry: ``python -m dlrover_tpu.master.main``.
+
+Equivalent capability: reference dlrover/python/master/main.py:44 run()
+which picks LocalJobMaster vs DistributedJobMaster by platform.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dlrover_tpu.common.constants import PlatformType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.master import DistributedJobMaster, LocalJobMaster
+from dlrover_tpu.scheduler.job import new_job_args
+
+logger = get_logger(__name__)
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="dlrover_tpu job master")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument(
+        "--platform",
+        type=str,
+        default=PlatformType.LOCAL,
+        choices=[
+            PlatformType.LOCAL,
+            PlatformType.KUBERNETES,
+            PlatformType.RAY,
+        ],
+    )
+    parser.add_argument("--job_name", type=str, default="dlrover-tpu-job")
+    parser.add_argument("--namespace", type=str, default="default")
+    parser.add_argument("--node_num", type=int, default=1)
+    parser.add_argument(
+        "--relaunch_on_worker_failure", type=int, default=3
+    )
+    return parser.parse_args(argv)
+
+
+def run(args) -> int:
+    job_args = new_job_args(
+        args.platform,
+        args.job_name,
+        args.namespace,
+        node_num=args.node_num,
+        relaunch_on_worker_failure=args.relaunch_on_worker_failure,
+    )
+    if args.platform == PlatformType.LOCAL:
+        master = LocalJobMaster(args.port, job_args)
+    else:
+        scaler = watcher = None
+        if args.platform == PlatformType.KUBERNETES:
+            from dlrover_tpu.scheduler.kubernetes import (
+                new_pod_scaler_and_watcher,
+            )
+
+            scaler, watcher = new_pod_scaler_and_watcher(job_args)
+        master = DistributedJobMaster(
+            args.port, job_args, scaler=scaler, watcher=watcher
+        )
+    master.prepare()
+    # Print the bound address so a parent (tpu-run) can discover the port.
+    print(f"DLROVER_MASTER_ADDR=127.0.0.1:{master.port}", flush=True)
+    return master.run()
+
+
+def main(argv=None) -> int:
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

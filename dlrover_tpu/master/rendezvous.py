@@ -1,0 +1,312 @@
+"""Master-side rendezvous managers.
+
+Equivalent capability: reference dlrover/python/master/elastic_training/
+rdzv_manager.py — ElasticTrainingRendezvousManager (:265) gathers waiting
+nodes into a world once min/max/node-unit/timeout conditions hold;
+NetworkCheckRendezvousManager (:311) pairs nodes over >=2 rounds of a
+device/collective probe to isolate the faulty node (_group_nodes :364) and
+flags stragglers at >2x median elapsed time (_detect_stragglers :505).
+
+TPU adaptation: instead of a torch TCPStore world, the comm world carries
+the JAX coordination-service address (rank-0 node ip:port) so workers can
+call ``jax.distributed.initialize`` with (coordinator, num_processes,
+process_id). The network check payload is an ICI/DCN mesh probe (see
+agent/node_check.py) but the master-side pairing/straggler logic is
+hardware-agnostic and unchanged in spirit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dlrover_tpu.common.constants import (
+    JobConstant,
+    NetworkFailureReason,
+    RendezvousName,
+)
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class RendezvousParameters:
+    def __init__(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT,
+        node_unit: int = 1,
+    ):
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.waiting_timeout = waiting_timeout
+        self.node_unit = max(node_unit, 1)
+
+
+class RendezvousManager:
+    """Base: collects waiting nodes, forms rounds."""
+
+    name = ""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._params = RendezvousParameters(0, 0)
+        # node_rank -> (local_world_size, node_ip)
+        self._waiting_nodes: dict[int, tuple[int, str]] = {}
+        self._rdzv_nodes: dict[int, tuple[int, str]] = {}
+        self._latest_rdzv_nodes: list[int] = []
+        self._rdzv_round = 0
+        self._first_join_time = 0.0
+        self._coordinator_port = 0
+        self._node_times: dict[int, float] = {}
+
+    def update_rdzv_params(
+        self, min_nodes, max_nodes, waiting_timeout, node_unit
+    ):
+        with self._lock:
+            self._params = RendezvousParameters(
+                min_nodes, max_nodes, waiting_timeout, node_unit
+            )
+
+    def set_coordinator_port(self, port: int):
+        self._coordinator_port = port
+
+    def get_min_nodes(self) -> int:
+        return self._params.min_nodes
+
+    def add_alive_node(self, node_rank: int):
+        pass
+
+    def remove_alive_node(self, node_rank: int):
+        """A node died: drop it from waiting so the next round can form
+        without it."""
+        with self._lock:
+            if node_rank in self._waiting_nodes:
+                self._waiting_nodes.pop(node_rank, None)
+                logger.info(
+                    "%s: removed dead node %s from waiting", self.name, node_rank
+                )
+
+    def join_rendezvous(
+        self, node_rank: int, local_world_size: int, node_ip: str = ""
+    ) -> int:
+        with self._lock:
+            if not self._waiting_nodes:
+                self._first_join_time = time.time()
+            self._waiting_nodes[node_rank] = (local_world_size, node_ip)
+            # joining invalidates the current formed round
+            self._rdzv_nodes = {}
+            return self._rdzv_round
+
+    def num_nodes_waiting(self) -> int:
+        """>0 means a membership change is pending — agents restart their
+        workers to re-rendezvous (reference _membership_changed)."""
+        with self._lock:
+            # While a round is formed and complete, nothing is "waiting".
+            if self._rdzv_nodes:
+                return 0
+            return len(self._waiting_nodes)
+
+    def _ready(self) -> bool:
+        p = self._params
+        n = len(self._waiting_nodes)
+        if n < max(p.min_nodes, 1):
+            return False
+        if p.max_nodes and n >= p.max_nodes:
+            return True
+        elapsed = time.time() - self._first_join_time
+        if elapsed >= p.waiting_timeout:
+            return True
+        return False
+
+    def _truncate_to_unit(self, ranks: list[int]) -> list[int]:
+        unit = self._params.node_unit
+        usable = (len(ranks) // unit) * unit
+        return sorted(ranks)[:usable]
+
+    def _form_round(self):
+        """Called under lock when ready: freeze waiting set into a world."""
+        ranks = self._truncate_to_unit(list(self._waiting_nodes.keys()))
+        self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
+        self._latest_rdzv_nodes = ranks
+        for r in ranks:
+            self._waiting_nodes.pop(r, None)
+        self._rdzv_round += 1
+        logger.info(
+            "%s rendezvous round %d formed with nodes %s",
+            self.name,
+            self._rdzv_round,
+            ranks,
+        )
+
+    def get_comm_world(self, node_rank: int):
+        raise NotImplementedError
+
+    def rdzv_round(self) -> int:
+        return self._rdzv_round
+
+    def clear_waiting_nodes(self):
+        with self._lock:
+            self._waiting_nodes.clear()
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    name = RendezvousName.ELASTIC_TRAINING
+
+    def get_comm_world(self, node_rank: int):
+        """Return (round, group, world, coordinator_addr). world is empty
+        until the round forms; callers poll."""
+        with self._lock:
+            if not self._rdzv_nodes and self._ready():
+                self._form_round()
+            if not self._rdzv_nodes or node_rank not in self._rdzv_nodes:
+                return self._rdzv_round, 0, {}, ""
+            world = {
+                r: lws for r, (lws, _ip) in sorted(self._rdzv_nodes.items())
+            }
+            first_rank = min(self._rdzv_nodes)
+            ip = self._rdzv_nodes[first_rank][1] or "127.0.0.1"
+            coordinator = f"{ip}:{self._coordinator_port or 7659}"
+            return self._rdzv_round, 0, world, coordinator
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Pairs nodes over successive probe rounds to isolate faults."""
+
+    name = RendezvousName.NETWORK_CHECK
+
+    def __init__(self):
+        super().__init__()
+        # round -> {node_rank: normal}
+        self._node_status: dict[int, dict[int, bool]] = {}
+        # round -> {node_rank: elapsed}
+        self._node_times_by_round: dict[int, dict[int, float]] = {}
+        self._check_round = 0
+        self._fault_nodes: set[int] = set()
+        self._stragglers: set[int] = set()
+        self._reported_leaks: set[int] = set()
+
+    def get_comm_world(self, node_rank: int):
+        with self._lock:
+            if not self._rdzv_nodes and self._ready():
+                self._form_round()
+                self._check_round += 1
+            if not self._rdzv_nodes or node_rank not in self._rdzv_nodes:
+                return self._rdzv_round, 0, {}, ""
+            groups = self._group_nodes(self._check_round)
+            for gi, group in enumerate(groups):
+                if node_rank in group:
+                    world = {
+                        r: self._rdzv_nodes[r][0] for r in sorted(group)
+                    }
+                    first = min(group)
+                    ip = self._rdzv_nodes[first][1] or "127.0.0.1"
+                    coordinator = f"{ip}:{(self._coordinator_port or 7659) + gi + 1}"
+                    return self._rdzv_round, gi, world, coordinator
+            return self._rdzv_round, 0, {}, ""
+
+    def _group_nodes(self, check_round: int) -> list[list[int]]:
+        """Pair nodes 2-by-2; alternate rounds rotate the pairing so a
+        node never keeps the same partner, which lets two failing rounds
+        pinpoint the bad node (reference _group_nodes :364)."""
+        ranks = sorted(self._rdzv_nodes.keys())
+        n = len(ranks)
+        if n <= 2:
+            return [ranks]
+        if check_round % 2 == 1:
+            pairs = [ranks[i : i + 2] for i in range(0, n - (n % 2), 2)]
+            if n % 2:
+                pairs[-1].append(ranks[-1])
+        else:
+            # rotate: last node pairs with first
+            rotated = [ranks[-1]] + ranks[:-1]
+            pairs = [rotated[i : i + 2] for i in range(0, n - (n % 2), 2)]
+            if n % 2:
+                pairs[-1].append(rotated[-1])
+        return pairs
+
+    def report_network_check_result(
+        self, node_rank: int, normal: bool, elapsed: float
+    ):
+        with self._lock:
+            rnd = self._check_round
+            self._node_status.setdefault(rnd, {})[node_rank] = normal
+            self._node_times_by_round.setdefault(rnd, {})[node_rank] = elapsed
+
+    def join_rendezvous(
+        self, node_rank: int, local_world_size: int, node_ip: str = ""
+    ) -> int:
+        with self._lock:
+            if not self._waiting_nodes:
+                self._first_join_time = time.time()
+                self._fault_nodes.clear()
+                self._stragglers.clear()
+            self._waiting_nodes[node_rank] = (local_world_size, node_ip)
+            self._rdzv_nodes = {}
+            return self._rdzv_round
+
+    def network_check_success(self) -> tuple[bool, str]:
+        """All nodes of the round reported and none is faulty."""
+        with self._lock:
+            rnd = self._check_round
+            statuses = self._node_status.get(rnd, {})
+            if not self._latest_rdzv_nodes:
+                return False, NetworkFailureReason.NO_INIT
+            if len(statuses) < len(self._latest_rdzv_nodes):
+                return False, NetworkFailureReason.WAITING_NODE
+            if all(statuses.get(r, False) for r in self._latest_rdzv_nodes):
+                return True, ""
+            return False, NetworkFailureReason.NODE_FAILURE
+
+    def check_fault_node(self) -> tuple[list[int], str]:
+        """A node is faulty if its probe group failed in two consecutive
+        rounds (different partners)."""
+        with self._lock:
+            rnd = self._check_round
+            statuses = self._node_status.get(rnd, {})
+            if len(statuses) < len(self._latest_rdzv_nodes):
+                return (
+                    sorted(self._fault_nodes),
+                    NetworkFailureReason.WAITING_NODE,
+                )
+            abnormal = {
+                r
+                for r in self._latest_rdzv_nodes
+                if not statuses.get(r, False)
+            }
+            if not abnormal:
+                self._fault_nodes.clear()
+                return [], ""
+            prev = self._node_status.get(rnd - 1)
+            if prev is None:
+                # first round: every member of a failed group is suspect;
+                # need another round to decide.
+                return [], NetworkFailureReason.WAITING_NODE
+            prev_abnormal = {
+                r for r, ok in prev.items() if not ok
+            }
+            self._fault_nodes = abnormal & prev_abnormal
+            if not self._fault_nodes:
+                return [], NetworkFailureReason.WAITING_NODE
+            return (
+                sorted(self._fault_nodes),
+                NetworkFailureReason.NODE_FAILURE,
+            )
+
+    def get_stragglers(self) -> tuple[list[int], bool]:
+        """Straggler = elapsed > 2x median of the round (reference
+        _detect_stragglers :505). Returns (stragglers, round_complete)."""
+        with self._lock:
+            rnd = self._check_round
+            times = self._node_times_by_round.get(rnd, {})
+            if len(times) < len(self._latest_rdzv_nodes) or not times:
+                return sorted(self._stragglers), False
+            values = sorted(times.values())
+            median = values[len(values) // 2]
+            self._stragglers = {
+                r
+                for r, t in times.items()
+                if median > 0 and t > 2 * median
+            }
+            return sorted(self._stragglers), True

@@ -1,0 +1,321 @@
+"""MasterServicer: dispatches the 2-verb control plane to managers.
+
+Equivalent capability: reference dlrover/python/master/servicer.py:62
+(MasterServicer.get :88 / report :285 dispatching on message type to the
+task manager, job manager, rendezvous managers, kv-store and sync
+service).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import RpcServer, RpcService
+
+logger = get_logger(__name__)
+
+
+class CheckpointBarrierService:
+    """Host-side all-rank-ready barrier for flash checkpoint.
+
+    Replaces the reference's in-band device collective
+    (flash_checkpoint/engine.py:51 check_all_rank_ready) with a
+    master-mediated barrier so the save path never touches the TPU.
+    """
+
+    # Bound the barrier book-keeping: only this many recent (group, step)
+    # entries are retained (a long-lived master checkpoints indefinitely).
+    MAX_ENTRIES = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (group, step) -> set of node ids that said ready (insertion
+        # ordered: oldest evicted first)
+        self._ready: dict[tuple[str, int], set[int]] = {}
+        # node agreement that step shards were persisted
+        self._persisted: dict[int, set[int]] = {}
+
+    def _evict(self, d: dict):
+        while len(d) > self.MAX_ENTRIES:
+            d.pop(next(iter(d)))
+
+    def report_ready(self, group: str, step: int, node_id: int, world: int):
+        with self._lock:
+            members = self._ready.setdefault((group, step), set())
+            members.add(node_id)
+            self._evict(self._ready)
+            return len(members) >= world
+
+    def check_ready(self, group: str, step: int, world: int) -> bool:
+        with self._lock:
+            return len(self._ready.get((group, step), set())) >= world
+
+    def sync_checkpoint(self, step: int, node_id: int, world: int) -> bool:
+        with self._lock:
+            members = self._persisted.setdefault(step, set())
+            members.add(node_id)
+            self._evict(self._persisted)
+            return len(members) >= world
+
+
+class MasterServicer(RpcService):
+    def __init__(
+        self,
+        task_manager=None,
+        job_manager=None,
+        rdzv_managers=None,
+        kv_store=None,
+        sync_service=None,
+        job_metric_collector=None,
+        elastic_ps_service=None,
+    ):
+        self.task_manager = task_manager
+        self.job_manager = job_manager
+        self.rdzv_managers = rdzv_managers or {}
+        self.kv_store = kv_store
+        self.sync_service = sync_service
+        self.job_metric_collector = job_metric_collector
+        self.ckpt_barrier = CheckpointBarrierService()
+        self._start_training_time = 0.0
+        self._job_ended = threading.Event()
+        self._job_success = True
+        self._run_configs: dict = {}
+
+    # ------------------------------------------------------------------ get
+
+    def get(self, node_type: str, node_id: int, message):
+        if isinstance(message, msg.TaskRequest):
+            return self._get_task(node_type, node_id, message)
+        if isinstance(message, msg.ShardCheckpointRequest):
+            content = self.task_manager.get_dataset_checkpoint(
+                message.dataset_name
+            )
+            return msg.ShardCheckpoint(content=content)
+        if isinstance(message, msg.CommWorldRequest):
+            return self._get_comm_world(message)
+        if isinstance(message, msg.WaitingNodeNumRequest):
+            mgr = self.rdzv_managers.get(message.rdzv_name)
+            n = mgr.num_nodes_waiting() if mgr else 0
+            return msg.WaitingNodeNum(waiting_num=n)
+        if isinstance(message, msg.NetworkReadyRequest):
+            mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+            ok, reason = mgr.network_check_success()
+            fault_nodes, fault_reason = mgr.check_fault_node()
+            return msg.NetworkCheckResult(
+                normal=ok and not fault_nodes,
+                reason=fault_reason or reason,
+                nodes=fault_nodes,
+            )
+        if isinstance(message, msg.StragglerExistRequest):
+            mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+            stragglers, done = mgr.get_stragglers()
+            return msg.NetworkCheckResult(
+                normal=done, nodes=stragglers, reason=""
+            )
+        if isinstance(message, msg.KeyValueGetRequest):
+            value = self.kv_store.get(message.key)
+            return msg.KeyValuePair(key=message.key, value=value)
+        if isinstance(message, msg.KeyValueAddRequest):
+            value = self.kv_store.add(message.key, message.delta)
+            return msg.KeyValueAddResult(value=value)
+        if isinstance(message, msg.HeartBeat):
+            action = self.job_manager.update_node_heartbeat(
+                node_type, node_id, message.timestamp
+            )
+            return msg.HeartbeatResponse(action=action or "")
+        if isinstance(message, msg.ParallelConfigRequest):
+            return self._get_paral_config(node_type, node_id)
+        if isinstance(message, msg.CheckpointReadyRequest):
+            passed = self.ckpt_barrier.check_ready(
+                message.group, message.step, message.world
+            )
+            return msg.BarrierResponse(passed=passed)
+        if isinstance(message, msg.ElasticRunConfigRequest):
+            return msg.ElasticRunConfig(configs=dict(self._run_configs))
+        if isinstance(message, msg.SyncBarrierRequest):
+            if message.notify:
+                self.sync_service.notify_barrier(message.sync_name)
+                return msg.Response(success=True)
+            return msg.Response(
+                success=self.sync_service.sync_finished(message.sync_name)
+            )
+        logger.warning("get: unhandled message %r", type(message).__name__)
+        return None
+
+    # --------------------------------------------------------------- report
+
+    def report(self, node_type: str, node_id: int, message) -> bool:
+        if isinstance(message, msg.DatasetShardParams):
+            self.task_manager.new_dataset(
+                batch_size=message.batch_size,
+                dataset_size=message.dataset_size,
+                dataset_name=message.dataset_name,
+                task_type=message.task_type,
+                num_epochs=message.num_epochs,
+                shuffle=message.shuffle,
+                num_minibatches_per_shard=message.num_minibatches_per_shard,
+                storage_type=message.storage_type,
+                dataset_type=message.dataset_type,
+            )
+            if self.job_metric_collector is not None:
+                self.job_metric_collector.collect_dataset_metric(message)
+            return True
+        if isinstance(message, msg.TaskResult):
+            return self._report_task_result(message)
+        if isinstance(message, msg.JoinRendezvousRequest):
+            mgr = self.rdzv_managers.get(message.rdzv_name)
+            if mgr is None:
+                return False
+            mgr.join_rendezvous(
+                message.node_rank, message.local_world_size, message.node_ip
+            )
+            return True
+        if isinstance(message, msg.NodeCheckResultRequest):
+            mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+            mgr.report_network_check_result(
+                message.node_id, message.normal, message.elapsed_time
+            )
+            return True
+        if isinstance(message, msg.ResourceStats):
+            self.job_manager.update_node_resource_usage(
+                node_type,
+                node_id,
+                message.cpu_percent,
+                message.memory_mb,
+                message.tpu_stats,
+            )
+            return True
+        if isinstance(message, msg.GlobalStep):
+            if self._start_training_time == 0:
+                self._start_training_time = time.time()
+            self.task_manager.speed_monitor.collect_global_step(
+                message.step, message.timestamp
+            )
+            return True
+        if isinstance(message, msg.NodeFailure):
+            self.job_manager.handle_training_failure(
+                node_type,
+                node_id,
+                message.restart_count,
+                message.error_data,
+                message.level,
+            )
+            return True
+        if isinstance(message, msg.KeyValuePair):
+            self.kv_store.set(message.key, message.value)
+            return True
+        if isinstance(message, msg.SyncJoin):
+            return self.sync_service.join_sync(
+                message.sync_name, node_type, node_id
+            )
+        if isinstance(message, msg.SyncFinish):
+            return self.sync_service.notify_barrier(message.sync_name)
+        if isinstance(message, msg.CheckpointReadyRequest):
+            return self.ckpt_barrier.report_ready(
+                message.group, message.step, message.node_id, message.world
+            )
+        if isinstance(message, msg.CheckpointSyncRequest):
+            world = self._alive_worker_num()
+            return self.ckpt_barrier.sync_checkpoint(
+                message.step, message.node_id, max(world, 1)
+            )
+        if isinstance(message, msg.ShardCheckpoint):
+            return self.task_manager.restore_dataset_from_checkpoint(
+                message.content
+            )
+        if isinstance(message, msg.DatasetTaskEnd):
+            return True
+        if isinstance(message, msg.NodeMeta):
+            node = self.job_manager.get_node(node_type, node_id)
+            if node is not None:
+                node.update_service_address(message.addr)
+            return True
+        if isinstance(message, msg.JobEnd):
+            self._job_success = message.success
+            self._job_ended.set()
+            return True
+        if isinstance(message, msg.DiagnosisReport):
+            logger.info(
+                "diagnosis from %s-%s [%s]: %s",
+                node_type,
+                node_id,
+                message.tag,
+                message.content[:200],
+            )
+            return True
+        logger.warning("report: unhandled message %r", type(message).__name__)
+        return False
+
+    # -------------------------------------------------------------- helpers
+
+    def _alive_worker_num(self) -> int:
+        from dlrover_tpu.common.constants import NodeStatus, NodeType
+
+        nodes = self.job_manager.get_job_nodes(NodeType.WORKER)
+        return sum(
+            1 for n in nodes.values() if n.status == NodeStatus.RUNNING
+        ) or len(nodes)
+
+    def _get_task(self, node_type, node_id, request: msg.TaskRequest):
+        task = self.task_manager.get_dataset_task(
+            node_type, node_id, request.dataset_name
+        )
+        return msg.Task(
+            task_id=task.task_id,
+            task_type=task.task_type,
+            shard=msg.Shard(
+                name=task.shard.name,
+                start=task.shard.start,
+                end=task.shard.end,
+                record_indices=list(task.shard.record_indices),
+            ),
+        )
+
+    def _report_task_result(self, result: msg.TaskResult) -> bool:
+        success = not result.err_message
+        return self.task_manager.report_dataset_task(
+            result.dataset_name, result.task_id, success
+        )
+
+    def _get_comm_world(self, request: msg.CommWorldRequest):
+        mgr = self.rdzv_managers.get(request.rdzv_name)
+        if mgr is None:
+            return msg.CommWorld(rdzv_name=request.rdzv_name)
+        rdzv_round, group, world, coordinator = mgr.get_comm_world(
+            request.node_id
+        )
+        return msg.CommWorld(
+            rdzv_name=request.rdzv_name,
+            round=rdzv_round,
+            group=group,
+            world=world,
+            coordinator_addr=coordinator,
+        )
+
+    def _get_paral_config(self, node_type, node_id):
+        node = self.job_manager.get_node(node_type, node_id)
+        if node is not None and node.paral_config is not None:
+            return node.paral_config
+        return msg.ParallelConfig()
+
+    @property
+    def job_ended(self) -> bool:
+        return self._job_ended.is_set()
+
+    @property
+    def job_success(self) -> bool:
+        return self._job_success
+
+    def set_run_configs(self, configs: dict):
+        self._run_configs = dict(configs)
+
+
+def create_master_service(port: int, **managers) -> tuple[RpcServer, MasterServicer]:
+    """Build the servicer + RPC server (reference servicer.py:580)."""
+    servicer = MasterServicer(**managers)
+    server = RpcServer(port, servicer)
+    return server, servicer

@@ -1,0 +1,201 @@
+"""Batch dataset manager: todo/doing/done task queues with recovery.
+
+Equivalent capability: reference dlrover/python/master/shard/
+batch_dataset_manager.py (BatchDatasetManager :29) + base_dataset_manager.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from typing import Dict, List
+
+from dlrover_tpu.common.constants import NodeType, TaskType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter, Shard
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class Task:
+    task_id: int
+    task_type: str
+    shard: Shard
+    retry_count: int = 0
+
+    @staticmethod
+    def create_invalid_task() -> "Task":
+        return Task(-1, TaskType.NONE, Shard())
+
+
+@dataclass
+class DoingTask:
+    task: Task
+    node_type: str
+    node_id: int
+    start_time: float
+
+
+class DatasetManager:
+    """Interface: assigns shards of one dataset to workers as tasks."""
+
+    def __init__(self, task_type: str, batch_size: int, splitter):
+        self._task_type = task_type
+        self._batch_size = batch_size
+        self._splitter: DatasetSplitter = splitter
+
+    def get_task(self, node_type, node_id) -> Task:
+        raise NotImplementedError
+
+    def report_task_status(self, task_id: int, success: bool):
+        raise NotImplementedError
+
+    def completed(self) -> bool:
+        raise NotImplementedError
+
+
+class BatchDatasetManager(DatasetManager):
+    def __init__(self, task_type: str, batch_size: int, dataset_splitter):
+        super().__init__(task_type, batch_size, dataset_splitter)
+        self.todo: List[Task] = []
+        self.doing: Dict[int, DoingTask] = {}
+        self._max_task_completed_time = 0.0
+        self._task_id = 0
+        self._completed_step = 0
+
+    @property
+    def completed_step(self) -> int:
+        return self._completed_step
+
+    def get_task(self, node_type, node_id) -> Task:
+        if not self.todo and not self._splitter.epoch_finished():
+            # Start a new epoch.
+            self._splitter.create_shards()
+            shards = self._splitter.get_shards()
+            self._create_tasks(shards)
+        if not self.todo:
+            return Task.create_invalid_task()
+        task = self.todo.pop(0)
+        self.doing[task.task_id] = DoingTask(
+            task, node_type, node_id, time.time()
+        )
+        return task
+
+    def _create_tasks(self, shards: list[Shard]):
+        for shard in shards:
+            self.todo.append(Task(self._task_id, self._task_type, shard))
+            self._task_id += 1
+
+    def report_task_status(self, task_id: int, success: bool):
+        doing_task = self.doing.pop(task_id, None)
+        if doing_task is None:
+            logger.warning("unknown or timed-out task %s reported", task_id)
+            return False, None
+        if not success:
+            logger.warning(
+                "task %s failed on %s-%s; requeue",
+                task_id,
+                doing_task.node_type,
+                doing_task.node_id,
+            )
+            doing_task.task.retry_count += 1
+            self.todo.append(doing_task.task)
+            return False, doing_task
+        elapsed = time.time() - doing_task.start_time
+        self._max_task_completed_time = max(
+            self._max_task_completed_time, elapsed
+        )
+        if doing_task.task.task_type == TaskType.TRAINING:
+            shard_records = (
+                doing_task.task.shard.end - doing_task.task.shard.start
+            )
+            self._completed_step += max(
+                shard_records // max(self._batch_size, 1), 1
+            )
+        return True, doing_task
+
+    def reset_doing_tasks_timeout(self, timeout: float | None = None):
+        """Requeue tasks whose worker went silent. Default timeout is
+        3x the historical max completion time (reference task recovery)."""
+        if timeout is None:
+            timeout = max(3 * self._max_task_completed_time, 600)
+        now = time.time()
+        expired = [
+            tid
+            for tid, dt in self.doing.items()
+            if now - dt.start_time > timeout
+        ]
+        for tid in expired:
+            doing_task = self.doing.pop(tid)
+            logger.warning("task %s timed out; requeue", tid)
+            self.todo.append(doing_task.task)
+        return expired
+
+    def recover_tasks_of_node(self, node_type: str, node_id: int):
+        """Requeue every doing task of a failed worker."""
+        ids = [
+            tid
+            for tid, dt in self.doing.items()
+            if dt.node_type == node_type and dt.node_id == node_id
+        ]
+        for tid in ids:
+            doing_task = self.doing.pop(tid)
+            self.todo.append(doing_task.task)
+        if ids:
+            logger.info(
+                "recovered %d tasks of %s-%s", len(ids), node_type, node_id
+            )
+
+    def completed(self) -> bool:
+        return (
+            not self.todo
+            and not self.doing
+            and self._splitter.epoch_finished()
+        )
+
+    def get_epoch(self) -> int:
+        return self._splitter.get_epoch()
+
+    # -- mid-job shard checkpoint (reference get/restore shard ckpt) -------
+
+    def checkpoint(self) -> str:
+        todo_ranges = [
+            [t.shard.start, t.shard.end, t.shard.record_indices]
+            for t in self.todo
+        ]
+        doing_ranges = [
+            [d.task.shard.start, d.task.shard.end, d.task.shard.record_indices]
+            for d in self.doing.values()
+        ]
+        return json.dumps(
+            {
+                "todo": todo_ranges,
+                "doing": doing_ranges,
+                "epoch": self._splitter.get_epoch(),
+                "completed_step": self._completed_step,
+                "dataset_name": self._splitter.dataset_name,
+            }
+        )
+
+    def restore_checkpoint(self, content: str):
+        state = json.loads(content)
+        self.todo.clear()
+        self.doing.clear()
+        self._splitter.epoch = state.get("epoch", 0)
+        self._completed_step = state.get("completed_step", 0)
+        shards = []
+        # doing tasks were in flight at ckpt time -> back to todo first.
+        for start, end, indices in state.get("doing", []) + state.get(
+            "todo", []
+        ):
+            shards.append(
+                Shard(
+                    name=state.get("dataset_name", ""),
+                    start=start,
+                    end=end,
+                    record_indices=indices,
+                )
+            )
+        self._create_tasks(shards)

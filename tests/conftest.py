@@ -1,0 +1,61 @@
+"""Test bootstrap: force the JAX CPU backend with 8 virtual devices.
+
+Must run before anything imports jax and initialises a backend. Mirrors
+the reference test strategy (SURVEY.md section 4): multi-device behavior is
+tested on a virtual host-platform mesh, no accelerators needed.
+"""
+
+import os
+import sys
+
+# Neutralize the sandbox's TPU-forcing site customization for tests.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 - already initialised to cpu
+    pass
+
+import pytest  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrover_tpu.common.rpc import find_free_port  # noqa: E402
+from dlrover_tpu.master.master import LocalJobMaster  # noqa: E402
+from dlrover_tpu.scheduler.job import new_job_args  # noqa: E402
+
+
+def start_local_master(node_num: int = 1):
+    """In-process LocalJobMaster on a free port (the key fixture of the
+    reference test suite, test_utils.start_local_master)."""
+    job_args = new_job_args("local", "test-job", node_num=node_num)
+    master = LocalJobMaster(0, job_args)
+    master.prepare()
+    return master
+
+
+@pytest.fixture
+def local_master():
+    master = start_local_master()
+    yield master
+    master.stop()
+
+
+@pytest.fixture
+def local_master_2nodes():
+    master = start_local_master(node_num=2)
+    yield master
+    master.stop()
+
+
+@pytest.fixture
+def free_port():
+    return find_free_port()

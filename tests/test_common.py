@@ -1,0 +1,240 @@
+"""Substrate tests: serialization, RPC, IPC primitives, storage, node model."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.ipc import (
+    PersistentSharedMemory,
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+    get_or_create_shm,
+)
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+from dlrover_tpu.common.rpc import RpcClient, RpcServer, RpcService
+from dlrover_tpu.common.serialize import (
+    deserialize_message,
+    serialize_message,
+)
+from dlrover_tpu.common.storage import (
+    KeepLatestStepStrategy,
+    PosixDiskStorage,
+)
+
+
+class TestSerialize:
+    def test_roundtrip_dataclass(self):
+        m = msg.Task(task_id=3, shard=msg.Shard(name="d", start=0, end=10))
+        m2 = deserialize_message(serialize_message(m))
+        assert m2.task_id == 3
+        assert m2.shard.end == 10
+
+    def test_forbidden_global(self):
+        import pickle
+
+        evil = pickle.dumps(eval)
+        with pytest.raises(Exception):
+            deserialize_message(evil)
+
+
+class _EchoService(RpcService):
+    def get(self, node_type, node_id, message):
+        return message
+
+    def report(self, node_type, node_id, message):
+        return True
+
+
+class TestRpc:
+    def test_get_report_roundtrip(self):
+        server = RpcServer(0, _EchoService())
+        server.start()
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        try:
+            out = client.get("worker", 0, msg.HeartBeat(node_id=7))
+            assert out.node_id == 7
+            assert client.report("worker", 0, msg.GlobalStep(step=5))
+            assert client.ping()
+        finally:
+            client.close()
+            server.stop()
+
+    def test_concurrent_clients(self):
+        server = RpcServer(0, _EchoService())
+        server.start()
+        errors = []
+
+        def worker(i):
+            c = RpcClient(f"127.0.0.1:{server.port}")
+            try:
+                for s in range(20):
+                    out = c.get("worker", i, msg.GlobalStep(step=s))
+                    if out.step != s:
+                        errors.append((i, s))
+            finally:
+                c.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        server.stop()
+        assert not errors
+
+
+class TestIpc:
+    def test_shared_lock(self):
+        lock = SharedLock(name=f"t{os.getpid()}", create=True)
+        try:
+            assert lock.acquire()
+            assert lock.locked()
+            assert not lock.acquire(blocking=False)
+            assert lock.release()
+            assert not lock.locked()
+        finally:
+            lock.unlink()
+
+    def test_shared_queue(self):
+        q = SharedQueue(name=f"tq{os.getpid()}", create=True)
+        try:
+            q.put({"step": 1})
+            assert q.qsize() == 1
+            assert q.get()["step"] == 1
+            assert q.empty()
+        finally:
+            q.unlink()
+
+    def test_shared_dict(self):
+        d = SharedDict(name=f"td{os.getpid()}", create=True)
+        try:
+            d.set({"a": 1})
+            d.set({"b": 2})
+            assert d.get() == {"a": 1, "b": 2}
+        finally:
+            d.unlink()
+
+    def test_shared_memory_grows(self):
+        name = f"dlrtpu_test_{os.getpid()}"
+        shm = get_or_create_shm(name, 1024)
+        shm.buf[:4] = b"abcd"
+        shm2 = get_or_create_shm(name, 2048)  # grows -> recreated
+        assert shm2.size >= 2048
+        shm2.close()
+        try:
+            shm2.unlink()
+        except FileNotFoundError:
+            pass
+
+    def test_shm_survives_without_tracker(self):
+        name = f"dlrtpu_pst_{os.getpid()}"
+        shm = PersistentSharedMemory(name=name, create=True, size=64)
+        shm.buf[:2] = b"ok"
+        shm.close()
+        shm2 = PersistentSharedMemory(name=name)
+        assert bytes(shm2.buf[:2]) == b"ok"
+        shm2.close()
+        shm2.unlink()
+
+
+class TestStorage:
+    def test_write_read_commit(self, tmp_path):
+        storage = PosixDiskStorage(
+            KeepLatestStepStrategy(2, str(tmp_path))
+        )
+        for step in (10, 20, 30):
+            d = tmp_path / f"checkpoint-{step}"
+            d.mkdir()
+            storage.write(b"x", str(d / "data.bin"))
+            storage.commit(step, True)
+        remaining = sorted(p.name for p in tmp_path.iterdir())
+        assert "checkpoint-10" not in remaining
+        assert "checkpoint-20" in remaining and "checkpoint-30" in remaining
+        assert storage.read(str(tmp_path / "checkpoint-30/data.bin"), "rb") == b"x"
+
+
+class TestNode:
+    def test_relaunch_bookkeeping(self):
+        node = Node("worker", 0, NodeResource(cpu=1), max_relaunch_count=2)
+        assert not node.is_unrecoverable_failure()
+        new = node.get_relaunch_node_info(5)
+        assert new.relaunch_count == 1 and new.id == 5
+        node.relaunch_count = 2
+        assert node.is_unrecoverable_failure()
+
+    def test_fatal_error_unrecoverable(self):
+        node = Node("worker", 0)
+        node.set_exit_reason(NodeExitReason.FATAL_ERROR)
+        assert node.is_unrecoverable_failure()
+
+    def test_heartbeat_timeout(self):
+        node = Node("worker", 0, status=NodeStatus.RUNNING)
+        node.heartbeat_time = time.time() - 100
+        assert node.timeout(50)
+        assert not node.timeout(500)
+
+    def test_resource_str_parse(self):
+        r = NodeResource.resource_str_to_node_resource(
+            "cpu=4,memory=8192Mi,tpu=8"
+        )
+        assert r.cpu == 4 and r.memory == 8192 and r.tpu_chips == 8
+
+
+class TestSecurityFixes:
+    def test_gadget_chain_blocked(self):
+        import pickle
+        import pytest as _pytest
+
+        class ImportGadget:
+            def __reduce__(self):
+                return (__import__, ("os",))
+
+        with _pytest.raises(Exception):
+            deserialize_message(pickle.dumps(ImportGadget()))
+
+        class GetattrGadget:
+            def __reduce__(self):
+                return (getattr, (int, "__add__"))
+
+        with _pytest.raises(Exception):
+            deserialize_message(pickle.dumps(GetattrGadget()))
+
+    def test_plain_containers_allowed(self):
+        obj = {"a": [1, 2.5], "b": (None, True), "c": {3, 4}}
+        assert deserialize_message(serialize_message(obj)) == obj
+
+    def test_lock_owner_enforced(self):
+        lock = SharedLock(name=f"own{os.getpid()}", create=True)
+        try:
+            assert lock.acquire()
+            # another "process" (different owner string) cannot release
+            assert not lock._srv_release(owner="someone-else")
+            assert lock.locked()
+            # but force release works (agent reclaiming after a crash)
+            assert lock._srv_release(owner="someone-else", force=True)
+            assert not lock.locked()
+        finally:
+            lock.unlink()
+
+    def test_rpc_client_reconnects_after_server_restart(self):
+        from dlrover_tpu.common.rpc import find_free_port
+
+        port = find_free_port()
+        server = RpcServer(port, _EchoService())
+        server.start()
+        client = RpcClient(f"127.0.0.1:{port}")
+        assert client.get("w", 0, msg.GlobalStep(step=1)).step == 1
+        server.stop()
+        server2 = RpcServer(port, _EchoService())
+        server2.start()
+        # must not deadlock; must reconnect and succeed
+        assert client.get("w", 0, msg.GlobalStep(step=2)).step == 2
+        client.close()
+        server2.stop()

@@ -1,0 +1,157 @@
+"""Fault-tolerance paths: node death propagation, relaunch policy, fake-k8s
+scaler/watcher (the reference mock_k8s_client pattern)."""
+
+import threading
+import time
+import types
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+    RendezvousName,
+)
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.job_manager import DistributedJobManager, NodeEvent
+from dlrover_tpu.master.master import DistributedJobMaster
+from dlrover_tpu.scheduler.job import new_job_args
+from dlrover_tpu.scheduler.kubernetes import PodWatcher, pod_to_node
+
+
+class _RecordingScaler:
+    def __init__(self):
+        self.relaunched = []
+        self.scaled = []
+
+    def scale(self, nodes):
+        self.scaled.append(list(nodes))
+
+    def relaunch(self, old, new):
+        self.relaunched.append((old.id, new.id))
+
+    def stop(self):
+        pass
+
+
+def test_node_exit_triggers_relaunch_and_callbacks():
+    job_args = new_job_args("local", "t", node_num=2)
+    scaler = _RecordingScaler()
+    mgr = DistributedJobManager(job_args, scaler=scaler)
+    exited = []
+    mgr.add_node_exit_callback(lambda n: exited.append(n.id))
+    mgr.start()
+    node = mgr.get_node(NodeType.WORKER, 0)
+    node.update_status(NodeStatus.RUNNING)
+    node.set_exit_reason(NodeExitReason.KILLED)
+    mgr._process_event(NodeEvent(NodeEventType.DELETED, node))
+    assert exited == [0]
+    assert scaler.relaunched == [(0, 2)]  # new id allocated after 0,1
+    assert mgr.get_node(NodeType.WORKER, 2) is not None
+    mgr.stop()
+
+
+def test_fatal_error_not_relaunched():
+    job_args = new_job_args("local", "t", node_num=1)
+    scaler = _RecordingScaler()
+    mgr = DistributedJobManager(job_args, scaler=scaler)
+    mgr.start()
+    node = mgr.get_node(NodeType.WORKER, 0)
+    node.update_status(NodeStatus.RUNNING)
+    node.set_exit_reason(NodeExitReason.FATAL_ERROR)
+    mgr._process_event(NodeEvent(NodeEventType.DELETED, node))
+    assert scaler.relaunched == []
+    mgr.stop()
+
+
+def test_heartbeat_timeout_generates_dead_node_event():
+    job_args = new_job_args("local", "t", node_num=1)
+    mgr = DistributedJobManager(job_args)
+    mgr._node_heartbeat_timeout = 1
+    mgr.start()
+    node = mgr.get_node(NodeType.WORKER, 0)
+    node.update_status(NodeStatus.RUNNING)
+    node.heartbeat_time = time.time() - 10
+    events = mgr._get_dead_node_events()
+    assert len(events) == 1
+    assert events[0].node.exit_reason == NodeExitReason.HARDWARE_ERROR
+    mgr.stop()
+
+
+def test_master_node_exit_drops_rdzv_and_requeues_tasks():
+    job_args = new_job_args("local", "t", node_num=2)
+    master = DistributedJobMaster(0, job_args, scaler=_RecordingScaler())
+    master.prepare()
+    try:
+        # register dataset; node 1 takes a task, then joins rendezvous
+        master.task_manager.new_dataset(
+            batch_size=2, dataset_size=8, dataset_name="train"
+        )
+        task = master.task_manager.get_dataset_task(
+            NodeType.WORKER, 1, "train"
+        )
+        assert task.task_id >= 0
+        rdzv = master.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        rdzv.join_rendezvous(1, 4)
+        # node 1 dies
+        node = master.job_manager.get_node(NodeType.WORKER, 1)
+        node.update_status(NodeStatus.RUNNING)
+        node.set_exit_reason(NodeExitReason.KILLED)
+        master.job_manager._process_event(
+            NodeEvent(NodeEventType.DELETED, node)
+        )
+        # its task went back to todo and its rendezvous slot is gone
+        ds = master.task_manager.get_dataset("train")
+        assert task.task_id not in ds.doing
+        assert rdzv.num_nodes_waiting() == 0
+    finally:
+        master.stop()
+
+
+class _FakePod:
+    def __init__(self, name, node_type, node_id, phase, host_ip="10.0.0.1"):
+        self.metadata = types.SimpleNamespace(
+            name=name,
+            labels={
+                "node-type": node_type,
+                "node-id": str(node_id),
+                "rank-index": str(node_id),
+            },
+        )
+        self.status = types.SimpleNamespace(phase=phase, host_ip=host_ip)
+
+
+class _FakeK8sClient:
+    def __init__(self, events):
+        self._events = events
+
+    def list_pods(self, selector):
+        return types.SimpleNamespace(
+            items=[_FakePod("p0", "worker", 0, "Running")]
+        )
+
+    def watch_pods(self, selector, timeout):
+        yield from self._events
+
+
+def test_pod_watcher_with_fake_client():
+    events = [
+        {"type": "ADDED", "object": _FakePod("p0", "worker", 0, "Pending")},
+        {"type": "MODIFIED", "object": _FakePod("p0", "worker", 0, "Running")},
+        {"type": "DELETED", "object": _FakePod("p0", "worker", 0, "Failed")},
+    ]
+    watcher = PodWatcher("job", _FakeK8sClient(events))
+    nodes = watcher.list()
+    assert nodes[0].status == NodeStatus.RUNNING
+    seen = [(e.event_type, e.node.status) for e in watcher.watch()]
+    assert seen == [
+        (NodeEventType.ADDED, NodeStatus.PENDING),
+        (NodeEventType.MODIFIED, NodeStatus.RUNNING),
+        (NodeEventType.DELETED, NodeStatus.FAILED),
+    ]
+
+
+def test_pod_to_node_bad_labels():
+    pod = _FakePod("p0", "worker", 0, "Running")
+    pod.metadata.labels = {"node-id": "xx"}
+    assert pod_to_node(pod) is None

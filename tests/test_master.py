@@ -1,0 +1,226 @@
+"""Master-layer tests against a real in-process LocalJobMaster + client."""
+
+import time
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeType, RendezvousName
+from dlrover_tpu.master.shard.dataset_splitter import (
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+)
+from dlrover_tpu.master.rendezvous import NetworkCheckRendezvousManager
+
+
+def make_client(master, node_id=0):
+    return MasterClient(master.addr, node_id, NodeType.WORKER)
+
+
+class TestDatasetSplitter:
+    def test_table_splitter(self):
+        sp = TableDatasetSplitter("d", 103, 10, num_epochs=2)
+        sp.create_shards()
+        shards = sp.get_shards()
+        assert len(shards) == 11
+        assert shards[-1].end == 103
+        assert not sp.epoch_finished()
+        sp.create_shards()
+        assert sp.epoch_finished()
+
+    def test_text_splitter_shuffle(self):
+        sp = TextDatasetSplitter("d", 50, 10, shuffle=True)
+        sp.create_shards()
+        indices = [i for s in sp.get_shards() for i in s.record_indices]
+        assert sorted(indices) == list(range(50))
+
+
+class TestShardingService:
+    def test_task_dispatch_and_recovery(self, local_master):
+        client = make_client(local_master)
+        try:
+            assert client.ping()
+            client.report_dataset_shard_params(
+                batch_size=4,
+                num_epochs=1,
+                dataset_size=32,
+                dataset_name="train",
+                num_minibatches_per_shard=2,
+            )
+            task = client.get_task("train")
+            assert task.task_id == 0
+            assert task.shard.end - task.shard.start == 8
+            # fail it -> requeued
+            client.report_task_result("train", task.task_id, "boom")
+            seen = set()
+            while True:
+                t = client.get_task("train")
+                if t.task_id < 0:
+                    break
+                seen.add((t.shard.start, t.shard.end))
+                client.report_task_result("train", t.task_id, "")
+            assert (task.shard.start, task.shard.end) in seen
+            assert local_master.task_manager.finished()
+        finally:
+            client.close()
+
+    def test_shard_checkpoint_roundtrip(self, local_master):
+        client = make_client(local_master)
+        try:
+            client.report_dataset_shard_params(
+                batch_size=2,
+                num_epochs=1,
+                dataset_size=8,
+                dataset_name="train",
+            )
+            t0 = client.get_task("train")
+            ckpt = client.get_shard_checkpoint("train")
+            assert ckpt
+            # restore: the in-flight task goes back to todo
+            assert client.report_shard_checkpoint(ckpt)
+            t1 = client.get_task("train")
+            starts = {t0.shard.start, t1.shard.start}
+            assert t0.shard.start in starts
+        finally:
+            client.close()
+
+
+class TestRendezvous:
+    def test_elastic_training_rdzv(self, local_master_2nodes):
+        c0 = make_client(local_master_2nodes, 0)
+        c1 = make_client(local_master_2nodes, 1)
+        try:
+            c0.join_rendezvous(0, 4, RendezvousName.ELASTIC_TRAINING)
+            w = c0.get_comm_world(RendezvousName.ELASTIC_TRAINING, 0)
+            assert w.world == {}  # not enough nodes yet
+            c1.join_rendezvous(1, 4, RendezvousName.ELASTIC_TRAINING)
+            w = c0.get_comm_world(RendezvousName.ELASTIC_TRAINING, 0)
+            assert w.world == {0: 4, 1: 4}
+            assert w.coordinator_addr
+            w1 = c1.get_comm_world(RendezvousName.ELASTIC_TRAINING, 1)
+            assert w1.world == w.world and w1.round == w.round
+            # no nodes waiting once the round formed
+            assert (
+                c0.num_nodes_waiting(RendezvousName.ELASTIC_TRAINING) == 0
+            )
+        finally:
+            c0.close()
+            c1.close()
+
+    def test_membership_change_signal(self, local_master_2nodes):
+        c0 = make_client(local_master_2nodes, 0)
+        c1 = make_client(local_master_2nodes, 1)
+        c2 = make_client(local_master_2nodes, 2)
+        try:
+            c0.join_rendezvous(0, 4, RendezvousName.ELASTIC_TRAINING)
+            c1.join_rendezvous(1, 4, RendezvousName.ELASTIC_TRAINING)
+            c0.get_comm_world(RendezvousName.ELASTIC_TRAINING, 0)
+            # a third node joins -> waiting_num > 0 signals a restart
+            c2.join_rendezvous(2, 4, RendezvousName.ELASTIC_TRAINING)
+            assert c0.num_nodes_waiting(RendezvousName.ELASTIC_TRAINING) > 0
+        finally:
+            c0.close()
+            c1.close()
+            c2.close()
+
+
+class TestNetworkCheck:
+    def _form(self, mgr, n):
+        for r in range(n):
+            mgr.join_rendezvous(r, 1)
+        for r in range(n):
+            mgr.get_comm_world(r)
+
+    def test_fault_isolation_two_rounds(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(4, 4, 60, 1)
+        # round 1: node 3 fails with its partner 2
+        self._form(mgr, 4)
+        for r in range(4):
+            mgr.report_network_check_result(r, r not in (2, 3), 1.0)
+        ok, _ = mgr.network_check_success()
+        assert not ok
+        faults, reason = mgr.check_fault_node()
+        assert faults == []  # needs a second round
+        # round 2 (re-paired): only node 3 fails again
+        self._form(mgr, 4)
+        for r in range(4):
+            mgr.report_network_check_result(r, r != 3, 1.0)
+        faults, reason = mgr.check_fault_node()
+        assert faults == [3]
+
+    def test_straggler_detection(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(4, 4, 60, 1)
+        self._form(mgr, 4)
+        times = {0: 1.0, 1: 1.1, 2: 0.9, 3: 5.0}
+        for r, t in times.items():
+            mgr.report_network_check_result(r, True, t)
+        stragglers, done = mgr.get_stragglers()
+        assert done and stragglers == [3]
+
+    def test_all_normal(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(2, 2, 60, 1)
+        self._form(mgr, 2)
+        for r in range(2):
+            mgr.report_network_check_result(r, True, 1.0)
+        ok, reason = mgr.network_check_success()
+        assert ok, reason
+
+
+class TestKVStoreAndBarrier:
+    def test_kv_store(self, local_master):
+        c = make_client(local_master)
+        try:
+            c.kv_store_set("k", b"v")
+            assert c.kv_store_get("k") == b"v"
+            assert c.kv_store_add("cnt", 2) == 2
+            assert c.kv_store_add("cnt", 3) == 5
+        finally:
+            c.close()
+
+    def test_ckpt_barrier(self, local_master):
+        c0 = make_client(local_master, 0)
+        c1 = make_client(local_master, 1)
+        try:
+            assert not c0.check_ckpt_barrier(10, "g", world=2)
+            c0.report_ckpt_ready(10, "g", world=2)
+            assert not c0.check_ckpt_barrier(10, "g", world=2)
+            c1.report_ckpt_ready(10, "g", world=2)
+            assert c0.check_ckpt_barrier(10, "g", world=2)
+        finally:
+            c0.close()
+            c1.close()
+
+
+class TestHeartbeatAndMetrics:
+    def test_heartbeat_marks_running(self, local_master):
+        c = make_client(local_master)
+        try:
+            resp = c.report_heart_beat()
+            assert resp.action == ""
+            node = local_master.job_manager.get_node(NodeType.WORKER, 0)
+            assert node is not None
+            assert node.heartbeat_time > 0
+        finally:
+            c.close()
+
+    def test_global_step_speed(self, local_master):
+        c = make_client(local_master)
+        try:
+            now = time.time()
+            c.report_global_step(10, now - 10)
+            c.report_global_step(110, now)
+            sm = local_master.task_manager.speed_monitor
+            assert sm.completed_global_step == 110
+            assert 5 < sm.running_speed < 20
+        finally:
+            c.close()
+
+    def test_job_end(self, local_master):
+        c = make_client(local_master)
+        try:
+            c.report_job_end(True)
+            assert local_master.servicer.job_ended
+            assert local_master.servicer.job_success
+        finally:
+            c.close()
