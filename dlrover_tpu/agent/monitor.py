@@ -1,0 +1,169 @@
+"""Agent-side monitors: node resources + training heartbeats.
+
+Equivalent capability: reference dlrover/python/elastic_agent/monitor/
+resource.py:86 (ResourceMonitor: psutil + accelerator stats ->
+report_used_resource) and monitor/training.py:77 (TorchTrainingMonitor:
+heartbeats + per-step metrics file).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from dlrover_tpu.common.constants import ConfigPath, JobConstant
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def get_process_cpu_percent() -> float:
+    try:
+        import psutil
+
+        return psutil.cpu_percent(interval=None)
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+def get_used_memory_mb() -> int:
+    try:
+        import psutil
+
+        return int(psutil.virtual_memory().used / (1024 * 1024))
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def get_tpu_stats() -> list:
+    """Best-effort TPU device stats via jax; empty off-device."""
+    try:
+        import jax
+
+        stats = []
+        for i, dev in enumerate(jax.local_devices()):
+            mem = getattr(dev, "memory_stats", None)
+            entry = {"index": i}
+            if callable(mem):
+                m = mem() or {}
+                entry["memory_used_gb"] = m.get("bytes_in_use", 0) / 1e9
+                entry["memory_total_gb"] = m.get("bytes_limit", 0) / 1e9
+            stats.append(entry)
+        return stats
+    except Exception:  # noqa: BLE001
+        return []
+
+
+class ResourceMonitor:
+    """Periodically reports host CPU/mem (+ TPU stats) to the master."""
+
+    def __init__(self, master_client, interval=JobConstant.MONITOR_INTERVAL):
+        self._client = master_client
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.report_tpu = False
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                self._client.report_used_resource(
+                    get_process_cpu_percent(),
+                    get_used_memory_mb(),
+                    get_tpu_stats() if self.report_tpu else [],
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            self._stopped.wait(self._interval)
+
+
+class HeartbeatReporter:
+    """Agent heartbeat loop; the master's heartbeat-timeout monitor
+    declares the node dead if these stop arriving."""
+
+    def __init__(self, master_client, interval=JobConstant.MONITOR_INTERVAL):
+        self._client = master_client
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.action = ""
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                resp = self._client.report_heart_beat()
+                if resp.action:
+                    self.action = resp.action
+            except Exception:  # noqa: BLE001
+                pass
+            self._stopped.wait(self._interval)
+
+
+class TrainingMetricsReporter:
+    """Relays per-step metrics a worker writes to the runtime-metrics
+    file up to the master (global step -> speed monitor)."""
+
+    def __init__(self, master_client, interval=JobConstant.MONITOR_INTERVAL):
+        self._client = master_client
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._last_step = -1
+        self._path = os.environ.get(
+            ConfigPath.ENV_RUNTIME_METRICS, ConfigPath.RUNTIME_METRICS
+        )
+
+    def start(self):
+        threading.Thread(
+            target=self._loop, name="metrics-reporter", daemon=True
+        ).start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                if os.path.exists(self._path):
+                    with open(self._path) as f:
+                        metrics = json.load(f)
+                    step = int(metrics.get("step", -1))
+                    if step > self._last_step:
+                        self._client.report_global_step(
+                            step, metrics.get("timestamp", time.time())
+                        )
+                        self._last_step = step
+            except Exception:  # noqa: BLE001
+                pass
+            self._stopped.wait(self._interval)
+
+
+def write_runtime_metrics(step: int, **extra):
+    """Called from the training loop (worker side) to publish progress."""
+    path = os.environ.get(
+        ConfigPath.ENV_RUNTIME_METRICS, ConfigPath.RUNTIME_METRICS
+    )
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"step": step, "timestamp": time.time(), **extra}, f)
+    os.replace(tmp, path)

@@ -1,0 +1,133 @@
+"""Node health-check payload: device matmul + collective probe.
+
+Equivalent capability: reference dlrover/trainer/torch/node_check/
+nvidia_gpu.py:26 (matmul rounds + 10x allgather of 2^24 floats, elapsed
+time written to a per-rank file; MOCK_ERR_RANK fault injection
+utils.py:50). TPU-native redesign: the probe runs a bf16 matmul loop on
+every local TPU device (MXU exercise) and a psum+all_gather over all
+local devices via pmap (ICI exercise); multi-host probes run the same
+program under jax.distributed so the collectives cross hosts. The agent
+times the run and reports (normal, elapsed) to the master, whose pairing
+logic (master/rendezvous.py NetworkCheckRendezvousManager) isolates the
+faulty node.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+CHECK_TIME_DIR = "/tmp/dlrover_tpu/node_check"
+
+MATMUL_SIZE = 1024
+MATMUL_ROUNDS = 10
+COLLECTIVE_ELEMS = 1 << 22  # 4M floats ~= 16MB, all_gather x devices
+COLLECTIVE_ROUNDS = 10
+
+
+def _mock_error() -> bool:
+    """Fault injection: MOCK_ERR_RANK=<node_rank> makes that node fail."""
+    mock_rank = os.environ.get(NodeEnv.MOCK_ERR_RANK, "")
+    node_rank = os.environ.get(NodeEnv.NODE_RANK, "0")
+    return mock_rank != "" and mock_rank == node_rank
+
+
+def matmul_probe(devices=None) -> float:
+    """Time a bf16 matmul loop on each local device (MXU health)."""
+    import jax
+    import jax.numpy as jnp
+
+    devices = devices or jax.local_devices()
+    start = time.time()
+    for dev in devices:
+        x = jax.device_put(
+            jnp.ones((MATMUL_SIZE, MATMUL_SIZE), dtype=jnp.bfloat16), dev
+        )
+        for _ in range(MATMUL_ROUNDS):
+            x = jnp.matmul(x, x) / MATMUL_SIZE
+        x.block_until_ready()
+    return time.time() - start
+
+
+def collective_probe(devices=None) -> float:
+    """Time psum + all_gather across local devices (ICI health); with a
+    multi-process jax.distributed setup the same collectives span DCN."""
+    import jax
+    import jax.numpy as jnp
+
+    devices = devices or jax.local_devices()
+    n = len(devices)
+    if n == 0:
+        raise RuntimeError("no devices to probe")
+    shape = (n, COLLECTIVE_ELEMS // max(n, 1))
+    x = jnp.ones(shape, dtype=jnp.float32)
+
+    probe = jax.pmap(
+        lambda v: jax.lax.psum(v, axis_name="d"),
+        axis_name="d",
+        devices=devices,
+    )
+    start = time.time()
+    for _ in range(COLLECTIVE_ROUNDS):
+        out = probe(x)
+    out.block_until_ready()
+    return time.time() - start
+
+
+def write_time_to_file(elapsed: float, normal: bool, local_rank: int = 0):
+    os.makedirs(CHECK_TIME_DIR, exist_ok=True)
+    path = os.path.join(CHECK_TIME_DIR, f"{local_rank}.json")
+    with open(path, "w") as f:
+        json.dump(
+            {"elapsed": elapsed, "normal": normal, "ts": time.time()}, f
+        )
+
+
+def read_time_from_file(local_rank: int = 0):
+    path = os.path.join(CHECK_TIME_DIR, f"{local_rank}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def run_node_check(local_rank: int = 0) -> tuple[bool, float]:
+    """The payload the agent executes (in-process or as a subprocess).
+
+    Returns (normal, elapsed_seconds)."""
+    start = time.time()
+    normal = True
+    try:
+        if _mock_error():
+            raise RuntimeError("mock node failure injected via MOCK_ERR_RANK")
+        import jax
+
+        devices = jax.local_devices()
+        if not devices:
+            raise RuntimeError("no local devices enumerated")
+        matmul_probe(devices)
+        collective_probe(devices)
+    except Exception as e:  # noqa: BLE001
+        logger.error("node check failed: %s", e)
+        normal = False
+    elapsed = time.time() - start
+    write_time_to_file(elapsed, normal, local_rank)
+    return normal, elapsed
+
+
+def main():
+    normal, elapsed = run_node_check(
+        int(os.environ.get(NodeEnv.LOCAL_RANK, "0"))
+    )
+    logger.info("node check: normal=%s elapsed=%.2fs", normal, elapsed)
+    raise SystemExit(0 if normal else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,496 @@
+"""Per-host elastic training agent.
+
+Equivalent capability: reference dlrover/python/elastic_agent/torch/
+training.py — ElasticTrainingAgent (:346) with master-driven rendezvous
+(MasterRendezvousHandler :165), run loop (_invoke_run :544: monitor
+workers, save-checkpoint-then-restart on failure :589, membership-change
+restart :602), launcher (launch_agent :673), ElasticLaunchConfig (:107);
+NetworkCheckElasticAgent (:783) running probe rounds and reporting to the
+master's pairing logic.
+
+TPU redesign: worker processes are JAX processes; the rendezvous hands
+them a JAX coordination-service address (env contract NodeEnv.JAX_*)
+instead of a torch TCPStore; the node check payload is the ICI/DCN probe
+in agent/node_check.py; failure taxonomy maps process exit codes AND
+XLA/libtpu error patterns to hardware-vs-software errors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.monitor import HeartbeatReporter, ResourceMonitor
+from dlrover_tpu.common.constants import (
+    ConfigPath,
+    ExitCode,
+    JobConstant,
+    NodeEnv,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class ElasticLaunchConfig:
+    """Launch configuration (reference ElasticLaunchConfig :107)."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    nproc_per_node: int = 1
+    node_rank: int = 0
+    max_restarts: int = 3
+    monitor_interval: float = JobConstant.TRAINING_AGENT_LOOP_INTERVAL
+    rdzv_timeout: float = JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT
+    network_check: bool = False
+    comm_perf_test: bool = False
+    node_unit: int = 1
+    auto_config: bool = False
+    auto_tunning: bool = False
+    exclude_straggler: bool = False
+    save_at_breakpoint: bool = False
+    accelerator: str = "tpu"
+    log_dir: str | None = None
+    run_id: str = "dlrover-tpu"
+
+    def auto_configure_params(self):
+        """--auto-config: infer process count from visible devices."""
+        if not self.auto_config:
+            return
+        try:
+            import jax
+
+            # One JAX process per host drives all local TPU chips.
+            self.nproc_per_node = 1
+            _ = jax.local_devices()
+        except Exception:  # noqa: BLE001
+            self.nproc_per_node = max(self.nproc_per_node, 1)
+
+
+class WorkerSpec:
+    def __init__(self, entrypoint: str, args: tuple, config: ElasticLaunchConfig):
+        self.entrypoint = entrypoint
+        self.args = args
+        self.config = config
+
+
+class MasterRendezvousHandler:
+    """Joins the master rendezvous and polls for the formed world
+    (reference MasterRendezvousHandler :165)."""
+
+    def __init__(
+        self,
+        name: str,
+        node_rank: int,
+        client: MasterClient,
+        local_world_size: int,
+        timeout: float,
+    ):
+        self._name = name
+        self._node_rank = node_rank
+        self._client = client
+        self._local_world_size = local_world_size
+        self._timeout = timeout
+
+    def next_rendezvous(self):
+        """Returns (round, world, rank_offset, total_world, coordinator)."""
+        self._client.join_rendezvous(
+            self._node_rank, self._local_world_size, self._name
+        )
+        start = time.time()
+        while True:
+            world = self._client.get_comm_world(self._name, self._node_rank)
+            if world and world.world and self._node_rank in world.world:
+                break
+            if time.time() - start > self._timeout:
+                raise TimeoutError(
+                    f"rendezvous {self._name} timed out after "
+                    f"{self._timeout}s (world={getattr(world, 'world', None)})"
+                )
+            time.sleep(1)
+        ranks = sorted(world.world.keys())
+        rank_offset = 0
+        for r in ranks:
+            if r == self._node_rank:
+                break
+            rank_offset += world.world[r]
+        total = sum(world.world.values())
+        return world.round, world.world, rank_offset, total, world.coordinator_addr
+
+
+class WorkerProcess:
+    def __init__(self, proc: subprocess.Popen, local_rank: int, global_rank: int):
+        self.proc = proc
+        self.local_rank = local_rank
+        self.global_rank = global_rank
+
+    @property
+    def returncode(self):
+        return self.proc.poll()
+
+
+# XLA/libtpu stderr patterns that indicate a device (hardware) problem
+# rather than a user-code bug — the TPU analogue of the reference's
+# exit-code taxonomy (training.py:353-356).
+_DEVICE_ERROR_PATTERNS = (
+    "XlaRuntimeError: INTERNAL",
+    "libtpu.so",
+    "TPU initialization failed",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "device or resource busy",
+)
+
+
+def classify_exit(returncode: int, log_tail: str = "") -> str:
+    if returncode == 0:
+        return "succeeded"
+    if returncode in ExitCode.HARDWARE_ERRORS or -returncode in (
+        signal.SIGABRT,
+        signal.SIGBUS,
+    ):
+        return "hardware"
+    if any(p in log_tail for p in _DEVICE_ERROR_PATTERNS):
+        return "hardware"
+    if returncode == ExitCode.OOM or -returncode == signal.SIGKILL:
+        return "oom"
+    return "software"
+
+
+class ElasticTrainingAgent:
+    """Runs and supervises the local worker processes of one node."""
+
+    def __init__(
+        self,
+        config: ElasticLaunchConfig,
+        spec: WorkerSpec,
+        client: MasterClient,
+    ):
+        self._config = config
+        self._spec = spec
+        self._client = client
+        self._workers: list[WorkerProcess] = []
+        self._restart_count = 0
+        self._remaining_restarts = config.max_restarts
+        self._rdzv_handler = MasterRendezvousHandler(
+            RendezvousName.ELASTIC_TRAINING,
+            config.node_rank,
+            client,
+            config.nproc_per_node,
+            config.rdzv_timeout,
+        )
+        self._heartbeat = HeartbeatReporter(client)
+        self._resource_monitor = ResourceMonitor(client)
+        self._log_files: list[str] = []
+        self._ckpt_saver = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _initialize_workers(self):
+        rdzv_round, world, rank_offset, total, coordinator = (
+            self._rdzv_handler.next_rendezvous()
+        )
+        logger.info(
+            "rendezvous round %s: world=%s rank_offset=%s total=%s",
+            rdzv_round,
+            world,
+            rank_offset,
+            total,
+        )
+        self._start_worker_processes(rank_offset, total, coordinator)
+
+    def _worker_env(self, local_rank: int, global_rank: int, total: int, coordinator: str):
+        env = dict(os.environ)
+        # Workers must import dlrover_tpu no matter where their script
+        # lives — propagate the framework's location.
+        import dlrover_tpu
+
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(dlrover_tpu.__file__))
+        )
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                f"{pkg_root}{os.pathsep}{existing}" if existing else pkg_root
+            )
+        env.update(
+            {
+                NodeEnv.DLROVER_MASTER_ADDR: self._client.master_addr,
+                NodeEnv.NODE_RANK: str(self._config.node_rank),
+                NodeEnv.NODE_ID: str(self._client.node_id),
+                NodeEnv.LOCAL_RANK: str(local_rank),
+                NodeEnv.RANK: str(global_rank),
+                NodeEnv.WORLD_SIZE: str(total),
+                NodeEnv.LOCAL_WORLD_SIZE: str(self._config.nproc_per_node),
+                NodeEnv.RESTART_COUNT: str(self._restart_count),
+                NodeEnv.JAX_COORDINATOR_ADDR: coordinator,
+                NodeEnv.JAX_PROCESS_ID: str(global_rank),
+                NodeEnv.JAX_NUM_PROCESSES: str(total),
+                ConfigPath.ENV_PARAL_CONFIG: ConfigPath.PARAL_CONFIG,
+                ConfigPath.ENV_RUNTIME_METRICS: ConfigPath.RUNTIME_METRICS,
+            }
+        )
+        return env
+
+    def _start_worker_processes(self, rank_offset, total, coordinator):
+        self._workers = []
+        self._log_files = []
+        log_dir = self._config.log_dir or "/tmp/dlrover_tpu/logs"
+        os.makedirs(log_dir, exist_ok=True)
+        for local_rank in range(self._config.nproc_per_node):
+            global_rank = rank_offset + local_rank
+            env = self._worker_env(
+                local_rank, global_rank, total, coordinator
+            )
+            if self._spec.entrypoint.endswith(".py"):
+                cmd = [sys.executable, self._spec.entrypoint, *self._spec.args]
+            else:
+                cmd = [self._spec.entrypoint, *self._spec.args]
+            log_path = os.path.join(
+                log_dir,
+                f"worker_{global_rank}_restart{self._restart_count}.log",
+            )
+            log_f = open(log_path, "ab")
+            proc = subprocess.Popen(  # noqa: S603
+                cmd,
+                env=env,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+            )
+            log_f.close()
+            self._log_files.append(log_path)
+            self._workers.append(
+                WorkerProcess(proc, local_rank, global_rank)
+            )
+        logger.info(
+            "started %d worker process(es), restart=%d",
+            len(self._workers),
+            self._restart_count,
+        )
+
+    def _stop_workers(self, timeout: float = 30.0):
+        for w in self._workers:
+            if w.returncode is None:
+                w.proc.terminate()
+        deadline = time.time() + timeout
+        for w in self._workers:
+            if w.returncode is None:
+                remaining = max(deadline - time.time(), 0.1)
+                try:
+                    w.proc.wait(timeout=remaining)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+                    w.proc.wait()
+        self._workers = []
+
+    def _restart_workers(self):
+        self._restart_count += 1
+        self._stop_workers()
+        self._initialize_workers()
+
+    def _log_tail(self, idx: int, nbytes: int = 4096) -> str:
+        try:
+            path = self._log_files[idx]
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(size - nbytes, 0))
+                return f.read().decode(errors="replace")
+        except Exception:  # noqa: BLE001
+            return ""
+
+    def _save_ckpt_at_breakpoint(self):
+        """Flush any checkpoint still in shared memory to storage before
+        restarting (reference _save_ckpt_to_storage :589)."""
+        if self._ckpt_saver is not None:
+            try:
+                self._ckpt_saver.save_shm_to_storage()
+            except Exception:  # noqa: BLE001
+                logger.exception("breakpoint checkpoint flush failed")
+
+    def set_ckpt_saver(self, saver):
+        self._ckpt_saver = saver
+
+    # ------------------------------------------------------------ run loop
+
+    def run(self) -> int:
+        self._heartbeat.start()
+        self._resource_monitor.start()
+        try:
+            self._initialize_workers()
+            return self._invoke_run()
+        finally:
+            self._stop_workers()
+            self._heartbeat.stop()
+            self._resource_monitor.stop()
+
+    def _invoke_run(self) -> int:
+        while True:
+            time.sleep(self._config.monitor_interval)
+            codes = [w.returncode for w in self._workers]
+            if all(c == 0 for c in codes):
+                logger.info("all workers succeeded")
+                try:
+                    self._client.report_job_end(True)
+                except ConnectionError:
+                    pass  # master already gone; local outcome stands
+                return 0
+            failed = [
+                (i, c) for i, c in enumerate(codes) if c not in (None, 0)
+            ]
+            if failed:
+                idx, code = failed[0]
+                tail = self._log_tail(idx)
+                kind = classify_exit(code, tail)
+                logger.warning(
+                    "worker %d exited rc=%s (%s)", idx, code, kind
+                )
+                self._client.report_failure(
+                    f"worker rc={code} kind={kind}: {tail[-1000:]}",
+                    TrainingExceptionLevel.PROCESS_ERROR,
+                    self._restart_count,
+                )
+                if self._config.save_at_breakpoint:
+                    self._save_ckpt_at_breakpoint()
+                if kind in ("software", "oom") and self._remaining_restarts <= 0:
+                    logger.error("restarts exhausted; failing node")
+                    self._client.report_job_end(False, "restarts exhausted")
+                    return 1
+                if kind == "hardware":
+                    # A device-level fault: exit with the hardware code so
+                    # the master relaunches this node elsewhere.
+                    logger.error("hardware-level fault; exiting agent")
+                    return ExitCode.DEVICE_ERROR
+                self._remaining_restarts -= 1
+                self._restart_workers()
+                continue
+            # workers healthy: check membership changes
+            if self._membership_changed():
+                logger.info("membership changed; restarting workers")
+                self._restart_workers()
+            if self._heartbeat.action == "stop":
+                logger.info("master asked this node to stop")
+                self._stop_workers()
+                return 0
+            if self._heartbeat.action == "restart":
+                self._heartbeat.action = ""
+                self._restart_workers()
+
+    def _membership_changed(self) -> bool:
+        try:
+            waiting = self._client.num_nodes_waiting(
+                RendezvousName.ELASTIC_TRAINING
+            )
+            return waiting > 0
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class NodeCheckElasticAgent:
+    """Runs probe rounds + reports to the master's pairing logic
+    (reference NetworkCheckElasticAgent :783)."""
+
+    def __init__(
+        self, config: ElasticLaunchConfig, client: MasterClient, rounds=2
+    ):
+        self._config = config
+        self._client = client
+        self._rounds = rounds
+        self._rdzv_handler = MasterRendezvousHandler(
+            RendezvousName.NETWORK_CHECK,
+            config.node_rank,
+            client,
+            config.nproc_per_node,
+            config.rdzv_timeout,
+        )
+
+    def _wait_round_verdict(self, timeout: float):
+        """Poll until every node of the round reported (the master stops
+        answering 'Waiting node') or the timeout passes."""
+        from dlrover_tpu.common.constants import NetworkFailureReason
+
+        deadline = time.time() + timeout
+        result = None
+        while time.time() < deadline:
+            result = self._client.check_network_ready()
+            if result is not None and (
+                result.normal
+                or result.reason != NetworkFailureReason.WAITING_NODE
+            ):
+                break
+            time.sleep(2)
+        return result
+
+    def run(self) -> bool:
+        from dlrover_tpu.agent.node_check import run_node_check
+
+        node_rank = self._config.node_rank
+        round_timeout = min(self._config.rdzv_timeout, 90)
+        result = None
+        for _ in range(self._rounds):
+            self._rdzv_handler.next_rendezvous()
+            normal, elapsed = run_node_check()
+            self._client.report_node_check_result(
+                node_rank, normal, elapsed
+            )
+            result = self._wait_round_verdict(round_timeout)
+            if result is not None and result.normal:
+                if self._config.exclude_straggler:
+                    straggler = self._client.check_straggler()
+                    if straggler and node_rank in straggler.nodes:
+                        logger.error(
+                            "this node is a straggler; excluding"
+                        )
+                        return False
+                return True
+            if result is not None and node_rank in result.nodes:
+                logger.error(
+                    "node %s isolated as faulty by the master", node_rank
+                )
+                return False
+            # round complete but undecided -> run another probe round
+        if result is None:
+            return False
+        if node_rank in result.nodes:
+            logger.error("node %s isolated as faulty", node_rank)
+            return False
+        if not result.normal:
+            logger.warning(
+                "network check inconclusive (%s); this node is not in the "
+                "fault set, continuing",
+                result.reason,
+            )
+        return True
+
+
+def launch_agent(
+    config: ElasticLaunchConfig,
+    entrypoint: str,
+    args: tuple,
+    master_addr: str,
+) -> int:
+    """Build the client + agent and run (reference launch_agent :673)."""
+    config.auto_configure_params()
+    client = MasterClient(
+        master_addr, config.node_rank, "worker"
+    )
+    if config.network_check:
+        checker = NodeCheckElasticAgent(config, client)
+        if not checker.run():
+            logger.error("node check failed; aborting this node")
+            return ExitCode.NETWORK_CHECK_FAILED
+    agent = ElasticTrainingAgent(
+        config, WorkerSpec(entrypoint, args, config), client
+    )
+    try:
+        return agent.run()
+    finally:
+        client.close()

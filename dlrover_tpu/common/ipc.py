@@ -20,11 +20,17 @@ import threading
 import time
 from multiprocessing import resource_tracker, shared_memory
 
+from dlrover_tpu.common.framing import recv_frame, send_frame
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
 
 SOCKET_DIR_ENV = "DLROVER_TPU_SOCKET_DIR"
+
+# Server-side blocking calls are chunked to this long so a handler thread
+# never outlives its client's socket by more than one slice (a blocked
+# orphan handler would otherwise steal the item its retry came for).
+_MAX_SRV_BLOCK = 5.0
 
 
 def _socket_dir() -> str:
@@ -43,35 +49,21 @@ def _rpc_over_unix_socket(path: str, request: tuple, timeout: float = 30.0):
     with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
         sock.settimeout(timeout)
         sock.connect(path)
-        payload = pickle.dumps(request)
-        sock.sendall(len(payload).to_bytes(4, "little") + payload)
-        size = int.from_bytes(_recv_exact(sock, 4), "little")
-        return pickle.loads(_recv_exact(sock, size))
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf.extend(chunk)
-    return bytes(buf)
+        send_frame(sock, pickle.dumps(request))
+        return pickle.loads(recv_frame(sock))
 
 
 class _UnixHandler(socketserver.BaseRequestHandler):
     def handle(self):
         sock = self.request
         try:
-            size = int.from_bytes(_recv_exact(sock, 4), "little")
-            method, args, kwargs = pickle.loads(_recv_exact(sock, size))
+            method, args, kwargs = pickle.loads(recv_frame(sock))
             owner = self.server.owner  # type: ignore[attr-defined]
             try:
                 result = (True, getattr(owner, "_srv_" + method)(*args, **kwargs))
             except Exception as e:  # noqa: BLE001
                 result = (False, f"{type(e).__name__}: {e}")
-            payload = pickle.dumps(result)
-            sock.sendall(len(payload).to_bytes(4, "little") + payload)
+            send_frame(sock, pickle.dumps(result))
         except (ConnectionError, OSError):
             pass
 
@@ -195,14 +187,27 @@ class SharedQueue(LocalSocketComm):
         )
         super().__init__(name, create)
 
+    _EMPTY = "__dlrover_tpu_queue_empty__"
+
     def _srv_put(self, obj, block=True, timeout=None):
         assert self._queue is not None
         self._queue.put(obj, block=block, timeout=timeout)
         return True
 
     def _srv_get(self, block=True, timeout=None):
+        # Never block longer than one slice: the client re-polls, so a
+        # dead client can't orphan a handler that later eats an item.
         assert self._queue is not None
-        return self._queue.get(block=block, timeout=timeout)
+        if not block:
+            timeout = 0.0
+        elif timeout is None or timeout > _MAX_SRV_BLOCK:
+            timeout = _MAX_SRV_BLOCK
+        try:
+            if timeout == 0.0:
+                return self._queue.get(block=False)
+            return self._queue.get(block=True, timeout=timeout)
+        except _queue.Empty:
+            return self._EMPTY
 
     def _srv_qsize(self):
         assert self._queue is not None
@@ -212,7 +217,26 @@ class SharedQueue(LocalSocketComm):
         return self._request("put", obj, block=block, timeout=timeout)
 
     def get(self, block: bool = True, timeout: float | None = None):
-        return self._request("get", block=block, timeout=timeout)
+        """Queue.get semantics: blocks (optionally bounded) and raises
+        queue.Empty on timeout/non-blocking miss. Implemented as a client
+        poll over short server-side slices."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            slice_timeout = _MAX_SRV_BLOCK if block else 0.0
+            if deadline is not None:
+                remaining = deadline - time.time()
+                if remaining <= 0 and block:
+                    raise _queue.Empty
+                slice_timeout = max(min(slice_timeout, remaining), 0.0)
+            result = self._request(
+                "get", block=block, timeout=slice_timeout
+            )
+            if result != self._EMPTY:
+                return result
+            if not block:
+                raise _queue.Empty
+            if deadline is not None and time.time() >= deadline:
+                raise _queue.Empty
 
     def qsize(self) -> int:
         return self._request("qsize")

@@ -18,38 +18,17 @@ from __future__ import annotations
 
 import socket
 import socketserver
-import struct
 import threading
 import time
 
+from dlrover_tpu.common.framing import (
+    recv_frame as _recv_frame,
+    send_frame as _send_frame,
+)
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.serialize import deserialize_message, serialize_message
 
 logger = get_logger(__name__)
-
-_HDR = struct.Struct("<I")
-MAX_FRAME = 1 << 30
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed connection")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def _send_frame(sock: socket.socket, payload: bytes):
-    sock.sendall(_HDR.pack(len(payload)) + payload)
-
-
-def _recv_frame(sock: socket.socket) -> bytes:
-    (length,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    if length > MAX_FRAME:
-        raise ValueError(f"frame too large: {length}")
-    return _recv_exact(sock, length)
 
 
 class RpcService:

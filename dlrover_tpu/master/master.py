@@ -94,15 +94,26 @@ class LocalJobMaster(JobMaster):
         logger.info("LocalJobMaster serving on %s", self.addr)
 
     def run(self):
+        tasks_done_at = 0.0
         try:
             while True:
                 if self.servicer.job_ended:
                     logger.info("job ended, master exiting")
                     return 0 if self.servicer.job_success else 1
                 if self.task_manager.finished():
-                    logger.info("all dataset tasks finished")
-                    return 0
-                time.sleep(2)
+                    # Grace period: workers are still draining their last
+                    # batch and the agent still needs the control plane to
+                    # report job end — don't yank it away immediately.
+                    if tasks_done_at == 0.0:
+                        tasks_done_at = time.time()
+                        logger.info("all dataset tasks finished")
+                    elif time.time() - tasks_done_at > 60:
+                        return 0
+                else:
+                    # A requeued task revived the job; restart the grace
+                    # window from scratch when it finishes again.
+                    tasks_done_at = 0.0
+                time.sleep(1)
         except KeyboardInterrupt:
             return 0
         finally:

@@ -79,13 +79,22 @@ class RendezvousManager:
         pass
 
     def remove_alive_node(self, node_rank: int):
-        """A node died: drop it from waiting so the next round can form
-        without it."""
+        """A node died: drop it from waiting, and if it was part of the
+        formed round, dissolve the round — survivors go back to waiting so
+        their agents see a membership change and re-rendezvous instead of
+        blocking in collectives with a dead peer."""
         with self._lock:
-            if node_rank in self._waiting_nodes:
-                self._waiting_nodes.pop(node_rank, None)
+            removed = self._waiting_nodes.pop(node_rank, None) is not None
+            if node_rank in self._rdzv_nodes:
+                self._rdzv_nodes.pop(node_rank)
+                for rank, info in self._rdzv_nodes.items():
+                    self._waiting_nodes.setdefault(rank, info)
+                self._rdzv_nodes = {}
+                self._first_join_time = time.time()
+                removed = True
+            if removed:
                 logger.info(
-                    "%s: removed dead node %s from waiting", self.name, node_rank
+                    "%s: removed dead node %s", self.name, node_rank
                 )
 
     def join_rendezvous(
@@ -296,17 +305,28 @@ class NetworkCheckRendezvousManager(RendezvousManager):
 
     def get_stragglers(self) -> tuple[list[int], bool]:
         """Straggler = elapsed > 2x median of the round (reference
-        _detect_stragglers :505). Returns (stragglers, round_complete)."""
+        _detect_stragglers :505). Returns (stragglers, round_complete).
+
+        True median (middle value, or mean of the two middles for even
+        counts); for exactly 2 nodes the faster node is the reference —
+        otherwise the slow node's own time dominates the median and the
+        rule can never fire."""
         with self._lock:
             rnd = self._check_round
             times = self._node_times_by_round.get(rnd, {})
             if len(times) < len(self._latest_rdzv_nodes) or not times:
                 return sorted(self._stragglers), False
             values = sorted(times.values())
-            median = values[len(values) // 2]
+            n = len(values)
+            if n == 2:
+                baseline = values[0]
+            elif n % 2 == 1:
+                baseline = values[n // 2]
+            else:
+                baseline = (values[n // 2 - 1] + values[n // 2]) / 2
             self._stragglers = {
                 r
                 for r, t in times.items()
-                if median > 0 and t > 2 * median
+                if baseline > 0 and t > 2 * baseline
             }
             return sorted(self._stragglers), True

@@ -103,11 +103,14 @@ class MasterServicer(RpcService):
             return msg.WaitingNodeNum(waiting_num=n)
         if isinstance(message, msg.NetworkReadyRequest):
             mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+            # ``reason`` is WAITING_NODE only while reports are missing —
+            # agents use that to tell "round still filling" apart from
+            # "round complete but fault undecided, run another round".
             ok, reason = mgr.network_check_success()
-            fault_nodes, fault_reason = mgr.check_fault_node()
+            fault_nodes, _ = mgr.check_fault_node()
             return msg.NetworkCheckResult(
                 normal=ok and not fault_nodes,
-                reason=fault_reason or reason,
+                reason=reason,
                 nodes=fault_nodes,
             )
         if isinstance(message, msg.StragglerExistRequest):

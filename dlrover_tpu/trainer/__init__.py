@@ -1,0 +1,45 @@
+"""Trainer-process APIs: distributed init, elastic data, flash checkpoint."""
+
+from __future__ import annotations
+
+import os
+
+from dlrover_tpu.common.constants import NodeEnv
+
+
+def init_distributed():
+    """Initialise JAX multi-process training from the agent's env contract.
+
+    The TPU analogue of torch's init_process_group bootstrap: the master's
+    rendezvous designated a coordinator (rank-0 host); every worker calls
+    jax.distributed.initialize against it. Single-process jobs no-op.
+    """
+    num = int(os.environ.get(NodeEnv.JAX_NUM_PROCESSES, "1"))
+    if num <= 1:
+        return False
+    import jax
+
+    coordinator = os.environ[NodeEnv.JAX_COORDINATOR_ADDR]
+    process_id = int(os.environ[NodeEnv.JAX_PROCESS_ID])
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_rank() -> int:
+    return int(os.environ.get(NodeEnv.RANK, "0"))
+
+
+def world_size() -> int:
+    return int(os.environ.get(NodeEnv.WORLD_SIZE, "1"))
+
+
+def local_rank() -> int:
+    return int(os.environ.get(NodeEnv.LOCAL_RANK, "0"))
+
+
+def node_rank() -> int:
+    return int(os.environ.get(NodeEnv.NODE_RANK, "0"))

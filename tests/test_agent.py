@@ -1,0 +1,205 @@
+"""Elastic agent tests: echo-entrypoint workers against a real local
+master (the reference pattern: test_elastic_training_agent.py drives the
+agent with entrypoint="echo")."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.node_check import run_node_check
+from dlrover_tpu.agent.training_agent import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    MasterRendezvousHandler,
+    NodeCheckElasticAgent,
+    WorkerSpec,
+    classify_exit,
+)
+from dlrover_tpu.common.constants import (
+    ExitCode,
+    NodeEnv,
+    NodeType,
+    RendezvousName,
+)
+
+
+def make_client(master, node_id=0):
+    return MasterClient(master.addr, node_id, NodeType.WORKER)
+
+
+class TestClassifyExit:
+    def test_success(self):
+        assert classify_exit(0) == "succeeded"
+
+    def test_software(self):
+        assert classify_exit(1) == "software"
+
+    def test_hardware_codes(self):
+        assert classify_exit(ExitCode.DEVICE_ERROR) == "hardware"
+        assert classify_exit(ExitCode.CORE_DUMP) == "hardware"
+
+    def test_xla_log_pattern(self):
+        assert (
+            classify_exit(1, "jax XlaRuntimeError: INTERNAL something")
+            == "hardware"
+        )
+
+    def test_oom(self):
+        assert classify_exit(ExitCode.OOM) == "oom"
+        assert classify_exit(-9) == "oom"
+
+
+class TestRendezvousHandler:
+    def test_single_node_rendezvous(self, local_master):
+        client = make_client(local_master)
+        try:
+            handler = MasterRendezvousHandler(
+                RendezvousName.ELASTIC_TRAINING, 0, client, 2, timeout=30
+            )
+            rnd, world, rank_offset, total, coordinator = (
+                handler.next_rendezvous()
+            )
+            assert world == {0: 2}
+            assert rank_offset == 0 and total == 2
+            assert coordinator
+        finally:
+            client.close()
+
+    def test_timeout(self, local_master_2nodes):
+        client = make_client(local_master_2nodes)
+        try:
+            handler = MasterRendezvousHandler(
+                RendezvousName.ELASTIC_TRAINING, 0, client, 1, timeout=3
+            )
+            with pytest.raises(TimeoutError):
+                handler.next_rendezvous()  # second node never joins
+        finally:
+            client.close()
+
+
+class TestElasticTrainingAgent:
+    def _agent(self, master, entrypoint, args=(), **cfg_kw):
+        config = ElasticLaunchConfig(
+            min_nodes=1,
+            max_nodes=1,
+            nproc_per_node=cfg_kw.pop("nproc", 1),
+            monitor_interval=0.3,
+            rdzv_timeout=30,
+            **cfg_kw,
+        )
+        client = make_client(master)
+        spec = WorkerSpec(entrypoint, args, config)
+        return ElasticTrainingAgent(config, spec, client), client
+
+    def test_successful_run(self, local_master, tmp_path):
+        script = tmp_path / "ok.py"
+        script.write_text("print('hello from worker')\n")
+        agent, client = self._agent(
+            local_master, str(script), log_dir=str(tmp_path)
+        )
+        try:
+            assert agent.run() == 0
+            assert local_master.servicer.job_ended
+        finally:
+            client.close()
+
+    def test_worker_env_contract(self, local_master, tmp_path):
+        script = tmp_path / "env.py"
+        script.write_text(
+            "import os, json\n"
+            "print(json.dumps({k: os.environ.get(k) for k in "
+            "['RANK','WORLD_SIZE','LOCAL_RANK',"
+            "'DLROVER_JAX_COORDINATOR_ADDR','DLROVER_JAX_NUM_PROCESSES']}))\n"
+        )
+        agent, client = self._agent(
+            local_master, str(script), nproc=2, log_dir=str(tmp_path)
+        )
+        try:
+            assert agent.run() == 0
+            logs = sorted(p for p in os.listdir(tmp_path) if p.endswith(".log"))
+            assert len(logs) == 2
+            import json
+
+            ranks = set()
+            for log in logs:
+                data = json.loads((tmp_path / log).read_text().strip())
+                ranks.add(data["RANK"])
+                assert data["WORLD_SIZE"] == "2"
+                assert data["DLROVER_JAX_NUM_PROCESSES"] == "2"
+            assert ranks == {"0", "1"}
+        finally:
+            client.close()
+
+    def test_restart_on_software_failure(self, local_master, tmp_path):
+        # fails on first attempt, succeeds after restart (state file)
+        marker = tmp_path / "marker"
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            f"import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            f"if not os.path.exists(m):\n"
+            f"    open(m, 'w').close()\n"
+            f"    sys.exit(1)\n"
+            f"print('recovered')\n"
+        )
+        agent, client = self._agent(
+            local_master, str(script), max_restarts=2, log_dir=str(tmp_path)
+        )
+        try:
+            assert agent.run() == 0
+            assert agent._restart_count == 1
+        finally:
+            client.close()
+
+    def test_restarts_exhausted(self, local_master, tmp_path):
+        script = tmp_path / "bad.py"
+        script.write_text("import sys; sys.exit(1)\n")
+        agent, client = self._agent(
+            local_master, str(script), max_restarts=1, log_dir=str(tmp_path)
+        )
+        try:
+            assert agent.run() == 1
+            assert local_master.servicer.job_ended
+            assert not local_master.servicer.job_success
+        finally:
+            client.close()
+
+    def test_hardware_error_exits_agent(self, local_master, tmp_path):
+        script = tmp_path / "hw.py"
+        script.write_text(f"import sys; sys.exit({ExitCode.DEVICE_ERROR})\n")
+        agent, client = self._agent(
+            local_master, str(script), max_restarts=3, log_dir=str(tmp_path)
+        )
+        try:
+            assert agent.run() == ExitCode.DEVICE_ERROR
+            # no restart was attempted for a hardware fault
+            assert agent._restart_count == 0
+        finally:
+            client.close()
+
+
+class TestNodeCheck:
+    def test_probe_runs_on_cpu_devices(self):
+        normal, elapsed = run_node_check()
+        assert normal
+        assert elapsed > 0
+
+    def test_mock_error_injection(self, monkeypatch):
+        monkeypatch.setenv(NodeEnv.MOCK_ERR_RANK, "0")
+        monkeypatch.setenv(NodeEnv.NODE_RANK, "0")
+        normal, _ = run_node_check()
+        assert not normal
+
+    def test_node_check_agent_single_node(self, local_master):
+        client = make_client(local_master)
+        try:
+            config = ElasticLaunchConfig(
+                min_nodes=1, max_nodes=1, rdzv_timeout=30
+            )
+            checker = NodeCheckElasticAgent(config, client, rounds=2)
+            assert checker.run()
+        finally:
+            client.close()

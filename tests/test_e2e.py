@@ -1,0 +1,90 @@
+"""End-to-end: tpu-run CLI launches master + agent + a real JAX worker
+that consumes master-served data shards (minimum end-to-end slice,
+SURVEY.md section 7 step 2)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAIN_SCRIPT = """
+import os
+import numpy as np
+import jax, jax.numpy as jnp
+
+from dlrover_tpu.agent.sharding_client import ShardingClient
+from dlrover_tpu.agent.monitor import write_runtime_metrics
+from dlrover_tpu import trainer as tpu_trainer
+
+tpu_trainer.init_distributed()
+
+client = ShardingClient(
+    dataset_name="train", batch_size=4, num_epochs=1, dataset_size=32
+)
+
+@jax.jit
+def step(w, x, y):
+    def loss_fn(w):
+        pred = x @ w
+        return jnp.mean((pred - y) ** 2)
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return w - 0.1 * g, loss
+
+w = jnp.zeros((8, 1))
+rng = np.random.RandomState(0)
+n_steps = 0
+while True:
+    shard = client.fetch_shard()
+    if shard is None:
+        break
+    n = shard.end - shard.start
+    x = jnp.asarray(rng.randn(n, 8), dtype=jnp.float32)
+    y = x @ jnp.ones((8, 1))
+    w, loss = step(w, x, y)
+    client.report_batch_done()
+    n_steps += 1
+    write_runtime_metrics(n_steps, loss=float(loss))
+
+print(f"TRAINED steps={n_steps} final_loss={float(loss):.4f}")
+assert n_steps == 4  # 32 samples / (4*2 per shard)
+"""
+
+
+def test_tpu_run_end_to_end(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(TRAIN_SCRIPT)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("DLROVER_MASTER_ADDR", None)
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.trainer.run",
+            "--nnodes",
+            "1",
+            "--nproc_per_node",
+            "1",
+            "--max-restarts",
+            "1",
+            "--log-dir",
+            str(tmp_path),
+            str(script),
+        ],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    logs = "\n".join(
+        (tmp_path / p).read_text()
+        for p in os.listdir(tmp_path)
+        if p.endswith(".log")
+    )
+    assert result.returncode == 0, (
+        f"stdout={result.stdout}\nstderr={result.stderr}\nlogs={logs}"
+    )
+    assert "TRAINED steps=4" in logs

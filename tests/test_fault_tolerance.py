@@ -155,3 +155,57 @@ def test_pod_to_node_bad_labels():
     pod = _FakePod("p0", "worker", 0, "Running")
     pod.metadata.labels = {"node-id": "xx"}
     assert pod_to_node(pod) is None
+
+
+def test_dead_node_dissolves_formed_round():
+    """Review fix: removing a node that is part of the FORMED round must
+    push survivors back to waiting so agents see a membership change."""
+    from dlrover_tpu.master.rendezvous import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(3, 3, 60, 1)
+    for r in range(3):
+        mgr.join_rendezvous(r, 1)
+    rnd, _, world, _ = mgr.get_comm_world(0)
+    assert world == {0: 1, 1: 1, 2: 1}
+    assert mgr.num_nodes_waiting() == 0
+    mgr.remove_alive_node(2)
+    # survivors are waiting again -> membership change signal fires
+    assert mgr.num_nodes_waiting() == 2
+
+
+def test_two_node_straggler_uses_fast_baseline():
+    from dlrover_tpu.master.rendezvous import NetworkCheckRendezvousManager
+
+    mgr = NetworkCheckRendezvousManager()
+    mgr.update_rdzv_params(2, 2, 60, 1)
+    for r in range(2):
+        mgr.join_rendezvous(r, 1)
+    for r in range(2):
+        mgr.get_comm_world(r)
+    mgr.report_network_check_result(0, True, 1.0)
+    mgr.report_network_check_result(1, True, 5.0)
+    stragglers, done = mgr.get_stragglers()
+    assert done and stragglers == [1]
+
+
+def test_shared_queue_blocking_timeout():
+    import queue as q
+
+    import pytest as _pytest
+
+    from dlrover_tpu.common.ipc import SharedQueue
+    import os as _os
+
+    sq = SharedQueue(name=f"bt{_os.getpid()}", create=True)
+    try:
+        with _pytest.raises(q.Empty):
+            sq.get(block=False)
+        with _pytest.raises(q.Empty):
+            sq.get(block=True, timeout=0.5)
+        sq.put("x")
+        assert sq.get(block=True, timeout=1.0) == "x"
+    finally:
+        sq.unlink()
