@@ -1,0 +1,507 @@
+"""Flash Checkpoint: shared-memory layout + agent-side async saver daemon.
+
+Equivalent capability: reference dlrover/python/elastic_agent/torch/
+ckpt_saver.py — SharedMemoryHandler (:209, tensor-meta dict + shm
+buffer), AsyncCheckpointSaver (:342) with its factory queue (:406),
+shm->storage event loop (:506), per-shard save (:533),
+save_shm_to_storage on failure/SIGTERM (:622), signal handlers (:468);
+CommonDirCheckpointSaver (:761), TempDirCheckpointSaver (:908).
+
+TPU redesign: the training process is a JAX host process whose
+addressable array shards are written (async HBM->host) into a
+POSIX shm segment; this module is deliberately **jax-free** — the agent
+daemon only moves bytes between shm and storage, so it keeps working
+while the training process is dead (that is the whole point: the
+checkpoint survives worker crashes and persists in the background).
+
+Shm layout:  [u64 meta_len][pickled meta][raw tensor bytes...]
+Meta: {"step": int, "paths": [leaf names], "leaves": [LeafMeta], ...}
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue as _queue
+import signal
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.ipc import (
+    SharedLock,
+    SharedQueue,
+    get_or_create_shm,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.storage import PosixDiskStorage
+
+logger = get_logger(__name__)
+
+_META_LEN_SIZE = 8
+
+SAVER_FACTORY_QUEUE = "ckpt_factory"
+
+
+def shm_name(local_rank: int = 0) -> str:
+    job = os.environ.get("ELASTIC_JOB_NAME", "local")
+    return f"dlrtpu_ckpt_{job}_{local_rank}"
+
+
+def lock_name(local_rank: int = 0) -> str:
+    return f"ckpt_shm_{local_rank}"
+
+
+def event_queue_name(local_rank: int = 0) -> str:
+    return f"ckpt_event_{local_rank}"
+
+
+@dataclass
+class LeafMeta:
+    """One array (or array shard) in the shm buffer."""
+
+    path: str = ""
+    dtype: str = ""
+    shape: tuple = ()
+    offset: int = 0
+    nbytes: int = 0
+    # GSPMD sharding info: the global shape of the array and the index of
+    # this host-local shard as ((start, stop) per dim); None => replicated
+    global_shape: tuple | None = None
+    index: tuple | None = None
+
+
+@dataclass
+class CheckpointMeta:
+    step: int = 0
+    leaves: list = field(default_factory=list)
+    treedef: bytes = b""
+    # which framework engine wrote it (replicated | sharded)
+    engine: str = "replicated"
+    host_rank: int = 0
+    num_hosts: int = 1
+    total_bytes: int = 0
+    user_meta: dict = field(default_factory=dict)
+
+
+@dataclass
+class SaveEvent:
+    step: int = 0
+    path: str = ""
+    storage_type: str = "disk"  # "disk" persists; "memory" = shm only
+
+
+class SharedMemoryHandler:
+    """Reads/writes the checkpoint shm segment (usable from either side
+    of the agent/worker boundary)."""
+
+    def __init__(self, local_rank: int = 0):
+        self._local_rank = local_rank
+        self._shm = None
+
+    @property
+    def shm(self):
+        return self._shm
+
+    def _ensure(self, size: int):
+        if self._shm is None or self._shm.size < size:
+            if self._shm is not None:
+                self._shm.close()
+            self._shm = get_or_create_shm(
+                shm_name(self._local_rank), size
+            )
+
+    def attach(self) -> bool:
+        """Attach to an existing segment (agent side)."""
+        try:
+            self._shm = get_or_create_shm(shm_name(self._local_rank))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def refresh(self):
+        """Drop the cached mapping and re-attach: the worker may have
+        unlinked+recreated the segment when the state dict grew, and a
+        cached mapping would keep reading the stale bytes forever."""
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
+        return self.attach()
+
+    def write_meta_and_reserve(self, meta: CheckpointMeta) -> memoryview:
+        """Write the meta header and return a view over the tensor area."""
+        meta_bytes = pickle.dumps(meta)
+        data_start = _META_LEN_SIZE + len(meta_bytes)
+        total = data_start + meta.total_bytes
+        self._ensure(total)
+        buf = self._shm.buf
+        buf[:_META_LEN_SIZE] = len(meta_bytes).to_bytes(
+            _META_LEN_SIZE, "little"
+        )
+        buf[_META_LEN_SIZE : data_start] = meta_bytes
+        return buf[data_start : data_start + meta.total_bytes]
+
+    def read(self) -> tuple[CheckpointMeta, memoryview] | None:
+        if self._shm is None and not self.attach():
+            return None
+        buf = self._shm.buf
+        meta_len = int.from_bytes(buf[:_META_LEN_SIZE], "little")
+        if meta_len == 0 or meta_len > self._shm.size:
+            return None
+        try:
+            meta: CheckpointMeta = pickle.loads(
+                bytes(buf[_META_LEN_SIZE : _META_LEN_SIZE + meta_len])
+            )
+        except Exception:  # noqa: BLE001 - partial/garbage header
+            return None
+        data_start = _META_LEN_SIZE + meta_len
+        return meta, buf[data_start : data_start + meta.total_bytes]
+
+    def get_checkpoint_step(self) -> int:
+        result = self.read()
+        return result[0].step if result else -1
+
+    def no_checkpoint_state(self) -> bool:
+        return self.read() is None
+
+    def mark_empty(self):
+        if self._shm is not None:
+            self._shm.buf[:_META_LEN_SIZE] = (0).to_bytes(
+                _META_LEN_SIZE, "little"
+            )
+
+    def close(self, unlink: bool = False):
+        if self._shm is not None:
+            self._shm.close()
+            if unlink:
+                try:
+                    self._shm.unlink()
+                except FileNotFoundError:
+                    pass
+            self._shm = None
+
+
+# --------------------------------------------------------------------------
+# storage file format: one file per host per step
+# --------------------------------------------------------------------------
+
+
+def host_shard_filename(host_rank: int) -> str:
+    return f"host_{host_rank}.dlck"
+
+
+def write_host_shard(storage, path: str, meta: CheckpointMeta, data) -> None:
+    meta_bytes = pickle.dumps(meta)
+    blob = bytearray()
+    blob += len(meta_bytes).to_bytes(_META_LEN_SIZE, "little")
+    blob += meta_bytes
+    blob += bytes(data)
+    storage.write(bytes(blob), path)
+
+
+def read_host_shard(path: str) -> tuple[CheckpointMeta, bytes] | None:
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        meta_len = int.from_bytes(f.read(_META_LEN_SIZE), "little")
+        meta = pickle.loads(f.read(meta_len))
+        data = f.read(meta.total_bytes)
+    return meta, data
+
+
+# --------------------------------------------------------------------------
+# the agent-side daemon
+# --------------------------------------------------------------------------
+
+
+class AsyncCheckpointSaver:
+    """Agent-side daemon: listens for save events from the training
+    process and persists shm checkpoints to storage in the background.
+
+    One instance per host; handles all local ranks' shm segments.
+    """
+
+    _saver_instance: "AsyncCheckpointSaver | None" = None
+    _factory_thread: threading.Thread | None = None
+
+    def __init__(
+        self,
+        checkpoint_dir: str = "",
+        local_shard_num: int = 1,
+        host_rank: int = 0,
+        num_hosts: int = 1,
+        master_client=None,
+        storage=None,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.local_shard_num = local_shard_num
+        self.host_rank = host_rank
+        self.num_hosts = num_hosts
+        self._master_client = master_client
+        self._storage = storage or PosixDiskStorage()
+        self._shm_handlers = [
+            SharedMemoryHandler(i) for i in range(local_shard_num)
+        ]
+        self._shm_locks = [
+            SharedLock(lock_name(i), create=True)
+            for i in range(local_shard_num)
+        ]
+        self._event_queues = [
+            SharedQueue(event_queue_name(i), create=True)
+            for i in range(local_shard_num)
+        ]
+        self._stopped = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(local_shard_num, 1),
+            thread_name_prefix="ckpt-shard-saver",
+        )
+        self._persisted_steps: set[int] = set()
+        self._last_persisted_step = -1
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        for i in range(self.local_shard_num):
+            t = threading.Thread(
+                target=self._sync_shm_to_storage,
+                args=(i,),
+                name=f"ckpt-saver-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        logger.info(
+            "AsyncCheckpointSaver started: dir=%s shards=%d",
+            self.checkpoint_dir,
+            self.local_shard_num,
+        )
+
+    def stop(self):
+        self._stopped.set()
+
+    @classmethod
+    def register_signal_handlers(cls):
+        """Persist whatever is in shm before dying on SIGTERM (pod
+        eviction) — reference ckpt_saver.py:468."""
+
+        def handler(signum, frame):  # noqa: ARG001
+            saver = cls._saver_instance
+            if saver is not None:
+                logger.info("SIGTERM: flushing shm checkpoint to storage")
+                try:
+                    saver.save_shm_to_storage()
+                except Exception:  # noqa: BLE001
+                    logger.exception("SIGTERM flush failed")
+            raise SystemExit(143)
+
+        signal.signal(signal.SIGTERM, handler)
+
+    @classmethod
+    def start_async_saving_ckpt(cls):
+        """Start the factory listener: the training process announces its
+        saver config on the factory queue; the agent builds the saver
+        (reference ckpt_saver.py:406-461)."""
+        if cls._factory_thread is not None:
+            return
+        factory_queue = SharedQueue(SAVER_FACTORY_QUEUE, create=True)
+
+        def factory_loop():
+            while True:
+                try:
+                    config = factory_queue.get(timeout=60)
+                except _queue.Empty:
+                    continue
+                except Exception:  # noqa: BLE001
+                    time.sleep(1)
+                    continue
+                try:
+                    if cls._saver_instance is None:
+                        cls._saver_instance = AsyncCheckpointSaver(**config)
+                        cls._saver_instance.start()
+                except Exception:  # noqa: BLE001
+                    logger.exception("failed to build checkpoint saver")
+
+        cls._factory_thread = threading.Thread(
+            target=factory_loop, name="ckpt-saver-factory", daemon=True
+        )
+        cls._factory_thread.start()
+
+    @classmethod
+    def get_ckpt_saver(cls):
+        return cls._saver_instance
+
+    @classmethod
+    def reset(cls):
+        if cls._saver_instance is not None:
+            cls._saver_instance.stop()
+            cls._saver_instance = None
+
+    # -- event loop --------------------------------------------------------
+
+    def _sync_shm_to_storage(self, local_rank: int):
+        """Reference ckpt_saver.py:506 — wait for save events, persist."""
+        q = self._event_queues[local_rank]
+        while not self._stopped.is_set():
+            try:
+                event: SaveEvent = q.get(timeout=5)
+            except _queue.Empty:
+                continue
+            except Exception:  # noqa: BLE001
+                time.sleep(1)
+                continue
+            if event.storage_type == "memory":
+                continue  # shm-only checkpoint; nothing to persist
+            try:
+                self.save_step_checkpoint(event, local_rank)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "persist step %s failed (rank %d)",
+                    event.step,
+                    local_rank,
+                )
+
+    # -- persistence -------------------------------------------------------
+
+    def _step_dir(self, path: str, step: int) -> str:
+        if path:
+            return path
+        return os.path.join(
+            self.checkpoint_dir,
+            f"{CheckpointConstant.STEP_DIR_PREFIX}{step}",
+        )
+
+    def save_step_checkpoint(self, event: SaveEvent, local_rank: int):
+        """Persist one local shard, then run the commit protocol."""
+        start = time.time()
+        lock = self._shm_locks[local_rank]
+        acquired = lock.acquire(blocking=True)
+        try:
+            self._shm_handlers[local_rank].refresh()
+            result = self._shm_handlers[local_rank].read()
+            if result is None:
+                logger.warning("no checkpoint in shm for rank %d", local_rank)
+                return
+            meta, data = result
+            if meta.step != event.step:
+                logger.warning(
+                    "shm holds step %s, event asked %s; saving shm step",
+                    meta.step,
+                    event.step,
+                )
+            step_dir = self._step_dir(event.path, meta.step)
+            self._save_shard(step_dir, meta, data, local_rank)
+            self._commit_checkpoint(step_dir, meta.step, local_rank)
+        finally:
+            if acquired:
+                lock.release(force=True)
+        logger.info(
+            "persisted step %s shard %d in %.2fs",
+            event.step,
+            local_rank,
+            time.time() - start,
+        )
+
+    def _save_shard(self, step_dir, meta, data, local_rank):
+        shard_id = self.host_rank * self.local_shard_num + local_rank
+        path = os.path.join(step_dir, host_shard_filename(shard_id))
+        write_host_shard(self._storage, path, meta, data)
+
+    def _commit_checkpoint(self, step_dir: str, step: int, local_rank):
+        """.done marker per shard; when all local shards + all nodes are
+        done, update the tracker file (reference commit_checkpoint :847)."""
+        done_dir = os.path.join(step_dir, ".done")
+        self._storage.safe_makedirs(done_dir)
+        shard_id = self.host_rank * self.local_shard_num + local_rank
+        self._storage.write("", os.path.join(done_dir, f"{shard_id}.done"))
+        # wait for every local shard of every host
+        total_shards = self.local_shard_num * self.num_hosts
+        deadline = time.time() + CheckpointConstant.SAVE_TIMEOUT
+        while time.time() < deadline:
+            done = len(
+                [
+                    f
+                    for f in self._storage.listdir(done_dir)
+                    if f.endswith(".done")
+                ]
+            )
+            if done >= total_shards:
+                break
+            time.sleep(0.5)
+        else:
+            logger.warning("commit timeout for step %s", step)
+            return
+        if self._master_client is not None and self.num_hosts > 1:
+            # cross-host agreement through the master
+            deadline = time.time() + CheckpointConstant.SAVE_TIMEOUT
+            while time.time() < deadline:
+                if self._master_client.sync_checkpoint(step):
+                    break
+                time.sleep(0.5)
+        # Finalize the directory BEFORE advertising the step in the
+        # tracker — a reader must never see a tracker pointing at a dir
+        # that does not exist yet.
+        self._finalize_step_dir(step_dir)
+        if self.host_rank == 0:
+            tracker = os.path.join(
+                self.checkpoint_dir or os.path.dirname(step_dir),
+                CheckpointConstant.TRACKER_FILE,
+            )
+            self._storage.write(str(step), tracker)
+            self._storage.commit(step, True)
+        self._last_persisted_step = step
+
+    def _finalize_step_dir(self, step_dir: str):
+        """Hook for atomic-rename savers; base saver writes in place."""
+
+    def save_shm_to_storage(self):
+        """Flush every local shard currently in shm to storage — called
+        when a worker dies or the agent gets SIGTERM (reference :622)."""
+        for local_rank in range(self.local_shard_num):
+            self._shm_handlers[local_rank].refresh()
+            result = self._shm_handlers[local_rank].read()
+            if result is None:
+                continue
+            meta, _ = result
+            if meta.step <= self._last_persisted_step:
+                continue
+            event = SaveEvent(step=meta.step, storage_type="disk")
+            try:
+                self.save_step_checkpoint(event, local_rank)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "breakpoint flush of shard %d failed", local_rank
+                )
+
+    # -- queries -----------------------------------------------------------
+
+    @staticmethod
+    def get_latest_step(checkpoint_dir: str) -> int:
+        tracker = os.path.join(
+            checkpoint_dir, CheckpointConstant.TRACKER_FILE
+        )
+        if not os.path.exists(tracker):
+            return -1
+        try:
+            with open(tracker) as f:
+                return int(f.read().strip())
+        except (ValueError, OSError):
+            return -1
+
+
+class TempDirCheckpointSaver(AsyncCheckpointSaver):
+    """Writes into a temp dir then atomically renames into place
+    (reference TempDirCheckpointSaver :908). The rename happens in
+    _finalize_step_dir, i.e. strictly before the tracker update."""
+
+    def _step_dir(self, path: str, step: int) -> str:
+        final = super()._step_dir(path, step)
+        return final + ".tmp"
+
+    def _finalize_step_dir(self, step_dir: str):
+        if self.host_rank == 0 and step_dir.endswith(".tmp"):
+            final = step_dir[: -len(".tmp")]
+            if os.path.exists(step_dir) and not os.path.exists(final):
+                os.replace(step_dir, final)

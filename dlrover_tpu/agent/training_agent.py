@@ -221,8 +221,14 @@ class ElasticTrainingAgent:
             env["PYTHONPATH"] = (
                 f"{pkg_root}{os.pathsep}{existing}" if existing else pkg_root
             )
+        # Job identity scopes shm segment names: stable across worker
+        # restarts of THIS job, distinct between jobs (a stale segment
+        # from a previous job must never be restored). The agent sets the
+        # same name in its own environ so the saver daemon and workers
+        # resolve identical segment names.
         env.update(
             {
+                NodeEnv.JOB_NAME: self._job_name(),
                 NodeEnv.DLROVER_MASTER_ADDR: self._client.master_addr,
                 NodeEnv.NODE_RANK: str(self._config.node_rank),
                 NodeEnv.NODE_ID: str(self._client.node_id),
@@ -310,18 +316,49 @@ class ElasticTrainingAgent:
     def _save_ckpt_at_breakpoint(self):
         """Flush any checkpoint still in shared memory to storage before
         restarting (reference _save_ckpt_to_storage :589)."""
-        if self._ckpt_saver is not None:
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        saver = self._ckpt_saver or AsyncCheckpointSaver.get_ckpt_saver()
+        if saver is not None:
             try:
-                self._ckpt_saver.save_shm_to_storage()
+                saver.save_shm_to_storage()
             except Exception:  # noqa: BLE001
                 logger.exception("breakpoint checkpoint flush failed")
 
     def set_ckpt_saver(self, saver):
         self._ckpt_saver = saver
 
+    def _cleanup_job_shm(self):
+        """Unlink this job's checkpoint shm segments after a clean finish
+        (they intentionally survive crashes, so nobody else reclaims
+        them)."""
+        from dlrover_tpu.agent.ckpt_saver import shm_name
+        from dlrover_tpu.common.ipc import PersistentSharedMemory
+
+        for local_rank in range(self._config.nproc_per_node):
+            name = shm_name(local_rank)
+            try:
+                seg = PersistentSharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+            except Exception:  # noqa: BLE001
+                logger.warning("shm cleanup failed for %s", name)
+
     # ------------------------------------------------------------ run loop
 
     def run(self) -> int:
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        # The agent hosts the async checkpoint-saver daemon so shm
+        # checkpoints survive (and get flushed) when workers die.
+        os.environ.setdefault(NodeEnv.JOB_NAME, self._job_name())
+        AsyncCheckpointSaver.start_async_saving_ckpt()
+        try:
+            AsyncCheckpointSaver.register_signal_handlers()
+        except ValueError:
+            pass  # not the main thread (tests)
         self._heartbeat.start()
         self._resource_monitor.start()
         try:
@@ -331,6 +368,11 @@ class ElasticTrainingAgent:
             self._stop_workers()
             self._heartbeat.stop()
             self._resource_monitor.stop()
+
+    def _job_name(self) -> str:
+        return os.environ.get(NodeEnv.JOB_NAME) or "job_" + (
+            self._client.master_addr.replace(".", "_").replace(":", "_")
+        )
 
     def _invoke_run(self) -> int:
         while True:
@@ -342,6 +384,7 @@ class ElasticTrainingAgent:
                     self._client.report_job_end(True)
                 except ConnectionError:
                     pass  # master already gone; local outcome stands
+                self._cleanup_job_shm()
                 return 0
             failed = [
                 (i, c) for i, c in enumerate(codes) if c not in (None, 0)

@@ -1,0 +1,10 @@
+from dlrover_tpu.trainer.flash_checkpoint.checkpointer import (  # noqa: F401
+    Checkpointer,
+    FlashCheckpointer,
+    StorageType,
+)
+from dlrover_tpu.trainer.flash_checkpoint.engine import (  # noqa: F401
+    CheckpointEngine,
+    ReplicatedCheckpointEngine,
+    ShardedCheckpointEngine,
+)

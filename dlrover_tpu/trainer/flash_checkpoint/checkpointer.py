@@ -1,0 +1,104 @@
+"""Flash-checkpoint user API.
+
+Equivalent capability: reference dlrover/trainer/torch/flash_checkpoint/
+checkpointer.py (Checkpointer ABC :23, StorageType :18) and the per-
+framework checkpointers (ddp.py, fsdp.py, megatron.py). One class covers
+both here: pick the engine by how the state is sharded.
+"""
+
+from __future__ import annotations
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.trainer.flash_checkpoint.engine import (
+    ReplicatedCheckpointEngine,
+    ShardedCheckpointEngine,
+)
+
+logger = get_logger(__name__)
+
+
+class StorageType:
+    MEMORY = 0
+    DISK = 1
+
+
+class Checkpointer:
+    """Interface (reference checkpointer.py:23)."""
+
+    def save_checkpoint(self, step, state_dict, path="", storage_type=None):
+        raise NotImplementedError
+
+    def load_checkpoint(self, path="", target=None):
+        raise NotImplementedError
+
+
+class FlashCheckpointer(Checkpointer):
+    """Asynchronous in-memory checkpointing for JAX pytrees.
+
+    Usage:
+        ckpt = FlashCheckpointer("/mnt/ckpt", sharded=True)
+        ckpt.save_checkpoint(step, {"params": params, "opt": opt_state},
+                             storage_type=StorageType.DISK)
+        restored, step = ckpt.load_checkpoint(target={"params": params,
+                                                      "opt": opt_state})
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        sharded: bool = True,
+        master_client: MasterClient | None = None,
+        local_rank: int | None = None,
+        host_rank: int | None = None,
+        num_hosts: int | None = None,
+        save_timeout: float = 600,
+    ):
+        import os
+
+        if host_rank is None or num_hosts is None:
+            try:
+                import jax
+
+                host_rank = jax.process_index()
+                num_hosts = jax.process_count()
+            except Exception:  # noqa: BLE001
+                host_rank, num_hosts = 0, 1
+        if local_rank is None:
+            local_rank = int(os.environ.get("LOCAL_RANK", "0"))
+        if master_client is None:
+            master_client = MasterClient.singleton_instance()
+        engine_cls = (
+            ShardedCheckpointEngine if sharded else ReplicatedCheckpointEngine
+        )
+        self.engine = engine_cls(
+            checkpoint_dir,
+            master_client=master_client,
+            local_rank=local_rank,
+            host_rank=host_rank,
+            num_hosts=num_hosts,
+            save_timeout=save_timeout,
+        )
+
+    def save_checkpoint(
+        self, step: int, state_dict, path: str = "", storage_type=None
+    ) -> bool:
+        if storage_type is None:
+            storage_type = StorageType.DISK
+        if storage_type == StorageType.MEMORY:
+            return self.engine.save_to_memory(step, state_dict)
+        return self.engine.save_to_storage(step, state_dict, path)
+
+    def load_checkpoint(self, path: str = "", target=None):
+        return self.engine.load(path, target)
+
+    def latest_step(self) -> int:
+        return self.engine.latest_step()
+
+    def wait_latest_checkpoint(self, timeout: float = 300) -> bool:
+        return self.engine.wait_for_persist(
+            self.engine._latest_step, timeout
+        )
+
+    def close(self):
+        self.engine.close()

@@ -1,0 +1,261 @@
+"""Flash-checkpoint tests: shm save/restore, async persistence, sharded
+(GSPMD) save with reassembly, breakpoint flush (reference
+test_ckpt_saver.py pattern: everything in one process, shm + unix-socket
+queues work intra-process)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+from dlrover_tpu.trainer.flash_checkpoint import (
+    FlashCheckpointer,
+    StorageType,
+)
+from dlrover_tpu.trainer.flash_checkpoint.engine import (
+    ReplicatedCheckpointEngine,
+    ShardedCheckpointEngine,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_ipc(tmp_path, monkeypatch):
+    """Fresh socket dir, job-scoped shm, saver singleton reset per test."""
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+    job = f"pytest{os.getpid()}"
+    monkeypatch.setenv("ELASTIC_JOB_NAME", job)
+    yield
+    AsyncCheckpointSaver.reset()
+    from dlrover_tpu.common.ipc import PersistentSharedMemory
+
+    try:
+        seg = PersistentSharedMemory(name=f"dlrtpu_ckpt_{job}_0")
+        seg.close()
+        seg.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def make_state(seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {
+        "params": {
+            "w": jax.random.normal(k, (16, 8), dtype=jnp.float32),
+            "b": jnp.zeros((8,), dtype=jnp.float32),
+        },
+        "step_count": jnp.asarray(3, dtype=jnp.int32),
+    }
+
+
+def trees_equal(a, b):
+    flat_a = jax.tree_util.tree_leaves(a)
+    flat_b = jax.tree_util.tree_leaves(b)
+    return all(
+        np.allclose(np.asarray(x), np.asarray(y))
+        for x, y in zip(flat_a, flat_b)
+    )
+
+
+class TestReplicatedEngine:
+    def test_memory_save_and_restore(self, tmp_path):
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        state = make_state()
+        assert engine.save_to_memory(10, state)
+        target = jax.tree.map(jnp.zeros_like, state)
+        restored, step = engine.load(target=target)
+        assert step == 10
+        assert trees_equal(restored, state)
+        engine.close()
+
+    def test_disk_persist_and_restore(self, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        engine = ReplicatedCheckpointEngine(ckpt_dir)
+        state = make_state()
+        assert engine.save_to_storage(20, state)
+        assert engine.wait_for_persist(20, timeout=30)
+        # simulate a full restart: wipe shm, load from disk
+        engine._shm_handler.mark_empty()
+        restored, step = engine.load(target=jax.tree.map(jnp.zeros_like, state))
+        assert step == 20
+        assert trees_equal(restored, state)
+        assert AsyncCheckpointSaver.get_latest_step(ckpt_dir) == 20
+        engine.close()
+
+    def test_shm_restore_beats_disk(self, tmp_path):
+        """Memory restore works with no disk files at all (in-memory
+        recovery after a worker-only crash)."""
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        state = make_state(1)
+        engine.save_to_memory(5, state)
+        restored, step = engine.load(
+            target=jax.tree.map(jnp.zeros_like, state)
+        )
+        assert step == 5 and trees_equal(restored, state)
+        engine.close()
+
+    def test_breakpoint_flush(self, tmp_path):
+        """Worker dies with a shm-only checkpoint; the agent flushes it
+        to storage (save_shm_to_storage)."""
+        ckpt_dir = str(tmp_path / "ckpt")
+        engine = ReplicatedCheckpointEngine(ckpt_dir)
+        state = make_state(2)
+        engine.save_to_memory(7, state)  # never asked for disk
+        saver = AsyncCheckpointSaver.get_ckpt_saver()
+        saver.save_shm_to_storage()
+        assert AsyncCheckpointSaver.get_latest_step(ckpt_dir) == 7
+        engine._shm_handler.mark_empty()
+        restored, step = engine.load(
+            target=jax.tree.map(jnp.zeros_like, state)
+        )
+        assert step == 7 and trees_equal(restored, state)
+        engine.close()
+
+
+class TestShardedEngine:
+    def _sharded_state(self, mesh):
+        k = jax.random.PRNGKey(0)
+        w = jax.device_put(
+            jax.random.normal(k, (16, 8), dtype=jnp.float32),
+            NamedSharding(mesh, P("dp", None)),
+        )
+        b = jax.device_put(
+            jnp.arange(8, dtype=jnp.float32),
+            NamedSharding(mesh, P(None)),
+        )
+        return {"w": w, "b": b}
+
+    def test_sharded_save_restore_same_mesh(self, tmp_path):
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+        state = self._sharded_state(mesh)
+        engine = ShardedCheckpointEngine(str(tmp_path / "ckpt"))
+        assert engine.save_to_storage(30, state)
+        assert engine.wait_for_persist(30, timeout=30)
+        engine._shm_handler.mark_empty()
+        target = jax.tree.map(
+            lambda x: jax.device_put(jnp.zeros_like(x), x.sharding), state
+        )
+        restored, step = engine.load(target=target)
+        assert step == 30
+        assert trees_equal(restored, state)
+        # restored arrays keep the target sharding
+        assert restored["w"].sharding == state["w"].sharding
+        engine.close()
+
+    def test_sharded_restore_to_different_mesh(self, tmp_path):
+        """Topology change: save on a (4,2) mesh, restore onto (2,4)."""
+        mesh1 = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+        state = self._sharded_state(mesh1)
+        engine = ShardedCheckpointEngine(str(tmp_path / "ckpt"))
+        assert engine.save_to_storage(40, state)
+        assert engine.wait_for_persist(40, timeout=30)
+        engine._shm_handler.mark_empty()
+        mesh2 = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+        target = {
+            "w": jax.device_put(
+                jnp.zeros((16, 8)), NamedSharding(mesh2, P("tp", "dp"))
+            ),
+            "b": jax.device_put(
+                jnp.zeros((8,)), NamedSharding(mesh2, P(None))
+            ),
+        }
+        restored, step = engine.load(target=target)
+        assert step == 40
+        assert trees_equal(restored, state)
+        assert restored["w"].sharding == target["w"].sharding
+        engine.close()
+
+    def test_shard_dedup(self, tmp_path):
+        """Replicated-axis shards are written once, not once per device."""
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+        state = self._sharded_state(mesh)
+        engine = ShardedCheckpointEngine(str(tmp_path / "ckpt"))
+        engine.save_to_memory(50, state)
+        meta, _ = engine._shm_handler.read()
+        w_leaves = [l for l in meta.leaves if "w" in l.path]
+        b_leaves = [l for l in meta.leaves if "b" in l.path]
+        assert len(w_leaves) == 4  # dp shards, tp-replicas deduped
+        assert len(b_leaves) == 1  # fully replicated -> a single copy
+        engine.close()
+
+
+class TestCheckpointerAPI:
+    def test_checkpointer_roundtrip(self, tmp_path):
+        ckpt = FlashCheckpointer(
+            str(tmp_path / "ckpt"), sharded=False, master_client=None
+        )
+        state = make_state()
+        assert ckpt.save_checkpoint(
+            11, state, storage_type=StorageType.MEMORY
+        )
+        restored, step = ckpt.load_checkpoint(
+            target=jax.tree.map(jnp.zeros_like, state)
+        )
+        assert step == 11 and trees_equal(restored, state)
+        ckpt.close()
+
+    def test_skip_when_lock_busy(self, tmp_path):
+        ckpt = FlashCheckpointer(
+            str(tmp_path / "ckpt"), sharded=False, master_client=None
+        )
+        state = make_state()
+        ckpt.engine._shm_lock.acquire()
+        try:
+            assert not ckpt.save_checkpoint(
+                12, state, storage_type=StorageType.MEMORY
+            )
+        finally:
+            ckpt.engine._shm_lock.release()
+        ckpt.close()
+
+
+class TestReviewFixes:
+    def test_no_views_into_shm(self, tmp_path):
+        """load() without target must return copies, not shm views."""
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        s1 = {"w": jnp.ones((8,))}
+        engine.save_to_memory(1, s1)
+        restored = engine.load()
+        w_before = restored["state"]["['w']"].copy()
+        engine.save_to_memory(2, {"w": jnp.full((8,), 9.0)})
+        assert np.allclose(restored["state"]["['w']"], w_before)
+        engine.close()
+
+    def test_agent_handler_refresh_after_regrow(self, tmp_path):
+        """Saver must re-attach after the worker unlinks+recreates the
+        segment on growth."""
+        ckpt_dir = str(tmp_path / "ckpt")
+        engine = ReplicatedCheckpointEngine(ckpt_dir)
+        engine.save_to_memory(1, {"w": jnp.ones((8,))})
+        saver = AsyncCheckpointSaver.get_ckpt_saver()
+        saver.save_shm_to_storage()
+        # grow the state massively -> segment recreated under same name
+        big = {"w": jnp.ones((8,)), "big": jnp.zeros((1 << 16,))}
+        engine.save_to_memory(2, big)
+        saver.save_shm_to_storage()
+        assert AsyncCheckpointSaver.get_latest_step(ckpt_dir) == 2
+        engine.close()
+
+    def test_stale_factory_socket_falls_back(self, tmp_path, monkeypatch):
+        """A dead factory socket file must not brick the engine."""
+        import pathlib
+
+        from dlrover_tpu.common.ipc import socket_path
+
+        sock = pathlib.Path(socket_path("queue", "ckpt_factory"))
+        sock.parent.mkdir(parents=True, exist_ok=True)
+        sock.touch()  # stale file, nothing listening
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        assert engine._standalone
+        assert engine.save_to_memory(1, {"w": jnp.ones((4,))})
+        engine.close()
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        engine.save_to_memory(1, {"w": jnp.ones((4,))})
+        with pytest.raises(ValueError, match="refusing"):
+            engine.load(target={"w": jnp.zeros((8, 8))})
+        engine.close()
