@@ -1,0 +1,22 @@
+"""TPU hot-path ops: Pallas kernels + XLA-fused primitives.
+
+Equivalent capability: the reference's CUDA op zoo — flash-attention
+wrappers (atorch/atorch/modules/transformer/layers.py:1168-1650), fused
+cross-entropy (modules/transformer/cross_entropy.py), and the C++/CUDA
+quantization kernels (atorch/atorch/ops/csrc/quantization/). TPU
+redesign: Pallas/Mosaic kernels targeting the MXU/VPU, with interpret-mode
+execution on CPU for tests.
+"""
+
+from dlrover_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    mha_reference,
+)
+from dlrover_tpu.ops.cross_entropy import (  # noqa: F401
+    softmax_cross_entropy,
+    vocab_parallel_cross_entropy,
+)
+from dlrover_tpu.ops.quantization import (  # noqa: F401
+    quantize_int8,
+    dequantize_int8,
+)

@@ -1,0 +1,70 @@
+"""Fused softmax cross-entropy, plus the vocab-parallel variant.
+
+Equivalent capability: reference fused cross-entropy
+(atorch/atorch/modules/transformer/cross_entropy.py) and the TP
+cross-entropy (modules/distributed_modules/cross_entropy.py) which
+computes the softmax over a vocab-sharded logits tensor with allreduces.
+TPU redesign: the fused form is a logsumexp-minus-gather that XLA fuses
+into the projection matmul's epilogue; the vocab-parallel form runs inside
+``shard_map`` over the ``tensor`` axis using two psums (max and sumexp) so
+the full logits row never materialises on one device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, ignore_index: int = -100):
+    """Token-level CE. logits [..., V] float, labels [...] int.
+
+    Returns (per-token loss [...], valid mask [...]). Loss is 0 where
+    ignored; caller averages by mask sum.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, safe_labels[..., None], axis=-1
+    )[..., 0]
+    loss = jnp.where(valid, lse - picked, 0.0)
+    return loss, valid
+
+
+def vocab_parallel_cross_entropy(
+    logits_shard, labels, axis_name: str = "tensor", ignore_index: int = -100
+):
+    """CE over logits sharded on the vocab dim along ``axis_name``.
+
+    Must be called inside shard_map/jit with ``axis_name`` in scope.
+    logits_shard [..., V/n]; labels are *global* vocab ids.
+    """
+    logits_shard = logits_shard.astype(jnp.float32)
+    shard_v = logits_shard.shape[-1]
+    shard_idx = jax.lax.axis_index(axis_name)
+    vocab_start = shard_idx * shard_v
+
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    local = safe_labels - vocab_start
+    in_shard = (local >= 0) & (local < shard_v)
+    local_clamped = jnp.clip(local, 0, shard_v - 1)
+
+    local_max = jnp.max(logits_shard, axis=-1)
+    global_max = jax.lax.pmax(local_max, axis_name)
+    sumexp = jnp.sum(
+        jnp.exp(logits_shard - global_max[..., None]), axis=-1
+    )
+    global_sumexp = jax.lax.psum(sumexp, axis_name)
+    lse = global_max + jnp.log(global_sumexp)
+
+    picked_local = jnp.take_along_axis(
+        logits_shard, local_clamped[..., None], axis=-1
+    )[..., 0]
+    picked = jax.lax.psum(
+        jnp.where(in_shard, picked_local, 0.0), axis_name
+    )
+    loss = jnp.where(valid, lse - picked, 0.0)
+    return loss, valid

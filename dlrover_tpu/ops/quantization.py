@@ -1,0 +1,107 @@
+"""Int8 block quantization kernels (optimizer-state compression).
+
+Equivalent capability: the reference's CUDA quantization kernels
+(atorch/atorch/ops/csrc/quantization/{quantize,dequantize,quant_reduce}.cu
+and the 8-bit Adam quantization_optimizer.cu) consumed by
+atorch/atorch/optimizers/low_bit/. TPU redesign: Pallas VPU kernels doing
+blockwise absmax int8 quantization with stochastic rounding (the unbiased
+rounding the reference gets from its CUDA kernel's RNG); used by the
+8-bit optimizer in dlrover_tpu/optimizers/low_bit.py. Interpret mode
+covers CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 256  # quantization group size (elements)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _quant_kernel(x_ref, u_ref, q_ref, scale_ref, *, stochastic):
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    scaled = x / scale
+    if stochastic:
+        # floor(x + u), u ~ U[0,1): unbiased rounding for any real x.
+        rounded = jnp.floor(scaled + u_ref[:])
+    else:
+        rounded = jnp.round(scaled)
+    q_ref[:] = jnp.clip(rounded, -127, 127).astype(jnp.int8)
+    scale_ref[:] = scale
+
+
+def _dequant_kernel(q_ref, scale_ref, out_ref):
+    out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[:]
+
+
+def _pad_to_blocks(flat):
+    n = flat.shape[0]
+    rows = pl.cdiv(n, BLOCK)
+    pad = rows * BLOCK - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(rows, BLOCK), n
+
+
+def quantize_int8(x, seed: int = 0, stochastic: bool = True,
+                  interpret: bool | None = None):
+    """Blockwise absmax int8 quantization.
+
+    Returns (q int8 [rows, BLOCK], scales f32 [rows, 1], orig_shape).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    orig_shape = x.shape
+    blocks, _n = _pad_to_blocks(x.reshape(-1))
+    rows = blocks.shape[0]
+    if stochastic:
+        u = jax.random.uniform(jax.random.key(seed), blocks.shape)
+    else:
+        u = jnp.zeros(blocks.shape, jnp.float32)
+    q, scales = pl.pallas_call(
+        functools.partial(_quant_kernel, stochastic=stochastic),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, BLOCK), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(blocks, u)
+    return q, scales, orig_shape
+
+
+def dequantize_int8(q, scales, orig_shape, dtype=jnp.float32,
+                    interpret: bool | None = None):
+    if interpret is None:
+        interpret = _use_interpret()
+    out = pl.pallas_call(
+        _dequant_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+        interpret=interpret,
+    )(q, scales)
+    n = 1
+    for d in orig_shape:
+        n *= d
+    return out.reshape(-1)[:n].reshape(orig_shape).astype(dtype)

@@ -1,0 +1,36 @@
+"""TPU-native parallelism fabric.
+
+Equivalent capability: reference atorch/atorch/distributed/distributed.py
+(create_parallel_group :321, parallel_group/parallel_rank :83-117) and the
+atorch auto_accelerate strategy machinery (atorch/atorch/auto/) — but
+re-designed for the XLA/GSPMD compilation model: instead of building nested
+torch process groups and wrapping modules, we build one
+``jax.sharding.Mesh`` with named axes and express every parallelism as a
+sharding rule over those axes. XLA inserts the collectives.
+"""
+
+from dlrover_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    get_mesh,
+    set_mesh,
+    axis_size,
+    axis_index,
+)
+from dlrover_tpu.parallel.sharding import (  # noqa: F401
+    LogicalRules,
+    DEFAULT_RULES,
+    logical_sharding,
+    shard_logical,
+    unsharded,
+)
+from dlrover_tpu.parallel.strategy import (  # noqa: F401
+    Strategy,
+    auto_strategy,
+    load_strategy,
+    save_strategy,
+)
+from dlrover_tpu.parallel.accelerate import (  # noqa: F401
+    AccelerateResult,
+    auto_accelerate,
+)

@@ -1,0 +1,250 @@
+"""``auto_accelerate`` — one call from (model fns, optimizer) to a fully
+sharded, jitted train step.
+
+Equivalent capability: atorch.auto_accelerate
+(atorch/atorch/auto/accelerate.py:406): the reference builds a
+ModelContext, searches/loads a Strategy, then *wraps* the model per method
+(DDP/FSDP/TP rewrite/pipe). TPU redesign: a Strategy is just shardings;
+"applying" it = (1) build the mesh, (2) compute NamedShardings for every
+state leaf from its logical axes, (3) jit the step with those shardings
+and let GSPMD insert collectives. There is no wrapping and no module
+rewriting; the same model code runs under every strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.mesh import build_mesh, set_mesh
+from dlrover_tpu.parallel.sharding import (
+    logical_to_mesh_axes,
+    shard_logical,
+)
+from dlrover_tpu.parallel.strategy import Strategy
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Minimal functional train state (params, optax opt state, step)."""
+
+    step: Any
+    params: Any
+    opt_state: Any
+
+    def tree_flatten(self):
+        return (self.step, self.params, self.opt_state), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _register_trainstate():
+    import jax
+
+    try:
+        jax.tree_util.register_pytree_node(
+            TrainState,
+            TrainState.tree_flatten,
+            lambda aux, ch: TrainState(*ch),
+        )
+    except ValueError:
+        pass  # already registered
+
+
+_register_trainstate()
+
+
+@dataclasses.dataclass
+class AccelerateResult:
+    """What auto_accelerate hands back (the AutoAccelerateResult analogue,
+    accelerate.py:372)."""
+
+    mesh: Any
+    strategy: Strategy
+    state: TrainState
+    state_shardings: TrainState
+    train_step: Callable  # (state, batch, rng) -> (state, metrics)
+    eval_step: Optional[Callable] = None
+
+
+def _compute_cast(params, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return params
+    target = jnp.dtype(dtype)
+
+    def cast(p):
+        if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating):
+            return p.astype(target)
+        return p
+
+    return jax.tree.map(cast, params)
+
+
+def _remat_wrap(loss_fn, policy_name: str):
+    import jax
+
+    if policy_name == "none":
+        return loss_fn
+    if policy_name == "minimal":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    else:  # "full"
+        policy = jax.checkpoint_policies.nothing_saveable
+    return jax.checkpoint(loss_fn, policy=policy)
+
+
+def auto_accelerate(
+    loss_fn: Callable,  # (params, batch, rng) -> scalar loss (or (loss, aux))
+    init_fn: Callable,  # (rng) -> params
+    optimizer,  # optax GradientTransformation
+    param_logical_axes,  # pytree matching params: tuples of logical names
+    strategy: Optional[Strategy] = None,
+    batch_logical_axes=("batch", "seq"),
+    devices=None,
+    has_aux: bool = False,
+    seed: int = 0,
+) -> AccelerateResult:
+    """Build mesh + sharded state + jitted train step for ``strategy``.
+
+    The returned ``train_step`` performs ``strategy.grad_accum``
+    microbatch accumulation with a ``lax.scan`` (keeping one compiled
+    program regardless of accumulation count) and applies the optimizer
+    update under the same shardings.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    strategy = strategy or Strategy()
+    mesh = build_mesh(strategy.mesh, devices=devices)
+    set_mesh(mesh)
+    rules = strategy.rules
+
+    def spec_of(axes):
+        return logical_to_mesh_axes(axes, rules)
+
+    param_specs = jax.tree.map(
+        spec_of,
+        param_logical_axes,
+        is_leaf=lambda x: isinstance(x, tuple) or x is None,
+    )
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda s: isinstance(s, PartitionSpec),
+    )
+
+    # Optimizer state shardings: leaves that mirror a param take its
+    # sharding; scalars (counts, schedules) replicate. We discover the
+    # correspondence structurally via eval_shape.
+    abstract_params = jax.eval_shape(init_fn, jax.random.key(seed))
+    abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
+    param_leaves = jax.tree.leaves(param_shardings)
+    shape_to_sharding = {}
+    for leaf, sh in zip(jax.tree.leaves(abstract_params), param_leaves):
+        shape_to_sharding.setdefault((leaf.shape, leaf.dtype), sh)
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def opt_leaf_sharding(leaf):
+        return shape_to_sharding.get((leaf.shape, leaf.dtype), replicated)
+
+    opt_shardings = jax.tree.map(opt_leaf_sharding, abstract_opt)
+    state_shardings = TrainState(
+        step=replicated, params=param_shardings, opt_state=opt_shardings
+    )
+
+    # ---- sharded init ------------------------------------------------------
+    def init_state(rng):
+        params = init_fn(rng)
+        opt_state = optimizer.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+        )
+
+    with mesh:
+        state = jax.jit(init_state, out_shardings=state_shardings)(
+            jax.random.key(seed)
+        )
+
+    # ---- train step --------------------------------------------------------
+    compute_dtype = strategy.compute_dtype
+    inner_loss = _remat_wrap(loss_fn, strategy.remat)
+    accum = max(int(strategy.grad_accum), 1)
+
+    def microbatch_grads(params, batch, rng):
+        cparams = _compute_cast(params, compute_dtype)
+        if has_aux:
+            grad_fn = jax.value_and_grad(inner_loss, has_aux=True)
+            (loss, aux), grads = grad_fn(cparams, batch, rng)
+        else:
+            grad_fn = jax.value_and_grad(inner_loss)
+            loss, grads = grad_fn(cparams, batch, rng)
+            aux = {}
+        grads = jax.tree.map(
+            lambda g, p: g.astype(p.dtype), grads, params
+        )
+        return loss, aux, grads
+
+    def train_step(state: TrainState, batch, rng):
+        batch = jax.tree.map(
+            lambda x: shard_logical(x, batch_logical_axes, rules), batch
+        )
+        if accum == 1:
+            loss, aux, grads = microbatch_grads(state.params, batch, rng)
+        else:
+            def split(x):
+                return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+            micro = jax.tree.map(split, batch)
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+
+            def body(carry, mb):
+                g_acc, l_acc = carry
+                loss, _aux, grads = microbatch_grads(state.params, mb, rng)
+                g_acc = jax.tree.map(jnp.add, g_acc, grads)
+                return (g_acc, l_acc + loss), None
+
+            (grads, loss_sum), _ = jax.lax.scan(
+                body, (zero_grads, jnp.zeros(())), micro
+            )
+            grads = jax.tree.map(lambda g: g / accum, grads)
+            loss, aux = loss_sum / accum, {}
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1, params=params, opt_state=opt_state
+        )
+        metrics = {"loss": loss, **aux}
+        return new_state, metrics
+
+    donate = (0,) if strategy.donate else ()
+    with mesh:
+        jitted_step = jax.jit(
+            train_step,
+            in_shardings=(state_shardings, None, None),
+            out_shardings=(state_shardings, None),
+            donate_argnums=donate,
+        )
+
+    def stepper(state, batch, rng):
+        with mesh:
+            return jitted_step(state, batch, rng)
+
+    logger.info("auto_accelerate ready: %s", strategy.describe())
+    return AccelerateResult(
+        mesh=mesh,
+        strategy=strategy,
+        state=state,
+        state_shardings=state_shardings,
+        train_step=stepper,
+    )

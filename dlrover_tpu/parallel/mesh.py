@@ -1,0 +1,146 @@
+"""Device-mesh construction with named parallelism axes.
+
+Equivalent capability: reference atorch create_parallel_group
+(atorch/atorch/distributed/distributed.py:321) which slices the world into
+nested process groups per parallelism dim ("tensor", "pipe", "data", ...).
+TPU redesign: one ``jax.sharding.Mesh`` whose axis order is chosen so that
+the most communication-hungry axes map to the innermost (fastest-ICI)
+device dimensions. No process groups — XLA derives collectives from
+shardings over the mesh.
+
+Canonical axis names (a superset of the reference's dim names):
+
+- ``data``    pure data parallelism (gradient psum only)
+- ``fsdp``    data parallelism with ZeRO-3-style parameter sharding
+- ``seq``     sequence/context parallelism (ring attention)
+- ``tensor``  Megatron-style tensor parallelism
+- ``expert``  MoE expert parallelism (all_to_all)
+- ``pipe``    pipeline stages
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Optional, Sequence, Tuple
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+# Axis order matters: jax places the *last* mesh axis on the most
+# tightly-coupled device dimension. Tensor parallelism is the most
+# latency-sensitive collective traffic, so it goes last; pipeline
+# stages tolerate DCN so they go first.
+AXIS_ORDER: Tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each named axis; 1 means the axis is inactive.
+
+    ``data=-1`` (or any single axis set to -1) means "absorb all
+    remaining devices", mirroring torchrun-style world-size inference.
+    """
+
+    pipe: int = 1
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def sizes(self, n_devices: int) -> dict:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        wildcard = [a for a, s in sizes.items() if s == -1]
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if len(wildcard) > 1:
+            raise ValueError(f"only one axis may be -1, got {wildcard}")
+        if wildcard:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[wildcard[0]] = n_devices // fixed
+        total = math.prod(sizes.values())
+        if total != n_devices:
+            raise ValueError(
+                f"mesh axes {sizes} use {total} devices, have {n_devices}"
+            )
+        return sizes
+
+    @property
+    def active_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a in AXIS_ORDER if getattr(self, a) != 1)
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a ``jax.sharding.Mesh`` over ``devices`` (default: all).
+
+    Uses ``mesh_utils.create_device_mesh`` so that on real TPU slices the
+    logical axes are laid out along the physical ICI torus; falls back to a
+    plain reshape on CPU/virtual platforms.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.sizes(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices, allow_split_physical_axes=True
+        )
+    except Exception:  # noqa: BLE001 - virtual/cpu platforms
+        dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, AXIS_ORDER)
+    logger.info("built mesh %s", {a: sizes[a] for a in AXIS_ORDER})
+    return mesh
+
+
+# -- process-global mesh (the analogue of atorch's module-level
+#    _parallel_group registry, distributed.py:83-117) ------------------------
+
+_state = threading.local()
+_global_mesh = None
+_global_lock = threading.Lock()
+
+
+def set_mesh(mesh) -> None:
+    global _global_mesh
+    with _global_lock:
+        _global_mesh = mesh
+
+
+def get_mesh():
+    """The active mesh: an enclosing ``with mesh:`` context if present,
+    else the process-global one set by :func:`set_mesh`."""
+    from jax.interpreters.pxla import thread_resources
+
+    env_mesh = thread_resources.env.physical_mesh
+    if env_mesh is not None and not env_mesh.empty:
+        return env_mesh
+    if _global_mesh is None:
+        raise RuntimeError("no mesh: call build_mesh()+set_mesh() first")
+    return _global_mesh
+
+
+def axis_size(axis: str) -> int:
+    """Size of a named axis on the active mesh (atorch parallel_group_size)."""
+    mesh = get_mesh()
+    return mesh.shape.get(axis, 1)
+
+
+def axis_index(axis: str):
+    """Inside jit/shard_map: this device's index along ``axis``
+    (atorch parallel_rank)."""
+    import jax
+
+    return jax.lax.axis_index(axis)

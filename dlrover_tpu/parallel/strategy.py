@@ -1,0 +1,142 @@
+"""Serializable parallelism strategies + the heuristic planner.
+
+Equivalent capability: atorch Strategy objects and the
+``load_strategy`` fast path (atorch/atorch/auto/accelerate.py:530-577) and
+the strategy-search engine's output (auto/engine/). TPU redesign: a
+Strategy is a MeshConfig + sharding-rule table + precision/remat knobs;
+"applying" it costs nothing at runtime because it only changes shardings
+handed to jit. ``auto_strategy`` is the deterministic planner (the
+analogue of atorch auto_config heuristics); a measured search can layer on
+top by scoring compiled-step timings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Tuple
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.mesh import AXIS_ORDER, MeshConfig
+from dlrover_tpu.parallel.sharding import DEFAULT_RULES, LogicalRules
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class Strategy:
+    """A complete, serializable acceleration plan."""
+
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    rules: LogicalRules = DEFAULT_RULES
+    # compute precision for matmuls/activations; params stay fp32 master.
+    compute_dtype: str = "bfloat16"
+    # remat policy name: none | minimal | full (jax.checkpoint policies)
+    remat: str = "minimal"
+    # number of microbatches for gradient accumulation (elastic trainer
+    # raises this as world size shrinks to keep global batch fixed).
+    grad_accum: int = 1
+    # optional donation of params/opt-state buffers in the train step.
+    donate: bool = True
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["rules"] = [list(r) for r in self.rules]
+        return json.dumps(d, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Strategy":
+        d = json.loads(s)
+        d["mesh"] = MeshConfig(**d["mesh"])
+        d["rules"] = tuple(
+            (name, tuple(ax) if isinstance(ax, list) else ax)
+            for name, ax in d["rules"]
+        )
+        return cls(**d)
+
+    def describe(self) -> str:
+        active = {
+            a: getattr(self.mesh, a)
+            for a in AXIS_ORDER
+            if getattr(self.mesh, a) != 1
+        }
+        return (
+            f"Strategy(mesh={active or 'dp-only'}, dtype={self.compute_dtype},"
+            f" remat={self.remat}, accum={self.grad_accum})"
+        )
+
+
+def save_strategy(strategy: Strategy, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(strategy.to_json())
+
+
+def load_strategy(path: str) -> Strategy:
+    with open(path) as f:
+        return Strategy.from_json(f.read())
+
+
+def _remat_for(param_bytes_per_device: float, hbm_bytes: float) -> str:
+    # Params + optimizer state (Adam: 2x fp32) + grads ~ 4x param bytes.
+    if param_bytes_per_device * 4 > hbm_bytes * 0.6:
+        return "full"
+    if param_bytes_per_device * 4 > hbm_bytes * 0.3:
+        return "minimal"
+    return "none"
+
+
+def auto_strategy(
+    n_devices: int,
+    param_count: int,
+    seq_len: int = 2048,
+    hbm_gb: float = 16.0,
+    devices_per_host: int = 4,
+    moe: bool = False,
+    n_experts: int = 1,
+    long_context_threshold: int = 32768,
+) -> Strategy:
+    """Deterministic planner (the atorch auto_config analogue).
+
+    Heuristics, TPU-first:
+    - Prefer FSDP (ZeRO-3 on the ``fsdp`` axis) until per-device param+opt
+      state fits comfortably; it has the best compute/communication ratio
+      on ICI and no model-code requirements.
+    - Add tensor parallelism only when a single FSDP shard of the layer
+      activations/params would still blow HBM, capping ``tensor`` at the
+      per-host device count so TP collectives never cross DCN.
+    - Activate ``seq`` (ring attention) for very long sequences.
+    - Activate ``expert`` for MoE models (expert count capped at device
+      count).
+    """
+    param_bytes = param_count * 4.0  # fp32 master params
+    hbm = hbm_gb * (1 << 30)
+
+    tensor = 1
+    # With pure FSDP over all devices, per-device footprint:
+    per_dev = param_bytes * 4 / n_devices
+    if per_dev > hbm * 0.5:
+        tensor = min(devices_per_host, n_devices)
+
+    seq = 1
+    if seq_len >= long_context_threshold:
+        # shard sequence enough that activations fit; activations scale
+        # ~seq^2 in attention score blocks but ring attention keeps them
+        # linear; 1 axis step per 32k tokens is a safe default.
+        seq = min(max(seq_len // long_context_threshold, 1), n_devices // tensor)
+        while (n_devices // tensor) % seq != 0:
+            seq -= 1
+
+    expert = 1
+    if moe and n_experts > 1:
+        expert = min(n_experts, max(n_devices // (tensor * seq), 1))
+        while (n_devices // (tensor * seq)) % expert != 0:
+            expert -= 1
+
+    fsdp = n_devices // (tensor * seq * expert)
+    mesh = MeshConfig(
+        pipe=1, data=1, fsdp=fsdp, expert=expert, seq=seq, tensor=tensor
+    )
+    remat = _remat_for(param_bytes * 4 / n_devices, hbm)
+    strategy = Strategy(mesh=mesh, remat=remat)
+    logger.info("auto_strategy: %s", strategy.describe())
+    return strategy

@@ -1,0 +1,115 @@
+"""Pallas op tests (interpret mode on the CPU test backend).
+
+Mirrors the reference's op-level unit tests (atorch flash-attn wrappers
+are tested against plain attention in atorch/atorch/tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.attention import flash_attention, mha_reference
+from dlrover_tpu.ops.cross_entropy import (
+    softmax_cross_entropy,
+    vocab_parallel_cross_entropy,
+)
+from dlrover_tpu.ops.quantization import dequantize_int8, quantize_int8
+
+
+def _qkv(batch=1, heads=4, kv_heads=2, seq=128, dim=64, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(batch, heads, seq, dim), jnp.float32)
+    k = jnp.asarray(rng.randn(batch, kv_heads, seq, dim), jnp.float32)
+    v = jnp.asarray(rng.randn(batch, kv_heads, seq, dim), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_forward(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+def test_flash_attention_grads_match_reference():
+    q, k, v = _qkv(seq=128)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=64, block_k=64) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        scale = float(jnp.max(jnp.abs(b))) + 1e-6
+        assert float(jnp.max(jnp.abs(a - b))) / scale < 5e-2
+
+
+def test_flash_attention_gqa_heads():
+    q, k, v = _qkv(heads=8, kv_heads=2)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+def test_softmax_cross_entropy_matches_optax():
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(4, 16, 64), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 64, (4, 16)))
+    loss, valid = softmax_cross_entropy(logits, labels)
+    import optax
+
+    ref = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=1e-5)
+    assert bool(valid.all())
+
+
+def test_softmax_cross_entropy_ignore_index():
+    logits = jnp.zeros((2, 3, 8))
+    labels = jnp.asarray([[0, -100, 2], [-100, 1, 3]])
+    loss, valid = softmax_cross_entropy(logits, labels)
+    assert int(valid.sum()) == 4
+    assert float(loss[0, 1]) == 0.0
+
+
+def test_vocab_parallel_cross_entropy():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    rng = np.random.RandomState(1)
+    vocab, n_shard = 64, 4
+    logits = jnp.asarray(rng.randn(8, vocab), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, vocab, (8,)))
+    devices = np.array(jax.devices()[:n_shard])
+    mesh = Mesh(devices, ("tensor",))
+    f = shard_map(
+        lambda lg, lb: vocab_parallel_cross_entropy(lg, lb)[0],
+        mesh=mesh,
+        in_specs=(P(None, "tensor"), P(None)),
+        out_specs=P(None),
+    )
+    loss = f(logits, labels)
+    ref, _ = softmax_cross_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=1e-4)
+
+
+def test_quantize_roundtrip():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(1000) * 3, jnp.float32)
+    q, scales, shape = quantize_int8(x, stochastic=False)
+    out = dequantize_int8(q, scales, shape)
+    assert out.shape == x.shape
+    # error bounded by scale/2 per block
+    max_scale = float(scales.max())
+    assert float(jnp.max(jnp.abs(out - x))) <= max_scale * 0.51
+
+
+def test_quantize_stochastic_unbiased():
+    x = jnp.full((4096,), 0.35, jnp.float32)
+    q, scales, shape = quantize_int8(x, seed=3, stochastic=True)
+    out = dequantize_int8(q, scales, shape)
+    # stochastic rounding preserves the mean
+    assert abs(float(out.mean()) - 0.35) < 5e-3

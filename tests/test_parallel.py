@@ -1,0 +1,151 @@
+"""Parallel fabric tests on the 8-device virtual CPU mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.parallel import (
+    MeshConfig,
+    Strategy,
+    auto_accelerate,
+    auto_strategy,
+    build_mesh,
+    load_strategy,
+    save_strategy,
+    set_mesh,
+)
+from dlrover_tpu.parallel.sharding import logical_to_mesh_axes
+
+
+def test_mesh_config_wildcard():
+    sizes = MeshConfig(tensor=2).sizes(8)
+    assert sizes["data"] == 4 and sizes["tensor"] == 2
+
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).sizes(8)
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert mesh.shape["pipe"] == 1
+
+
+def test_logical_rules_mapping():
+    spec = logical_to_mesh_axes(("batch", "seq", "embed"))
+    assert spec == jax.sharding.PartitionSpec(("data", "fsdp"), "seq")
+    # "embed" falls back to None because fsdp is already used by batch
+    spec2 = logical_to_mesh_axes(("embed", "mlp"))
+    assert spec2 == jax.sharding.PartitionSpec("fsdp", "tensor")
+
+
+def test_strategy_roundtrip(tmp_path):
+    s = Strategy(mesh=MeshConfig(fsdp=4, tensor=2), remat="full")
+    p = str(tmp_path / "strategy.json")
+    save_strategy(s, p)
+    s2 = load_strategy(p)
+    assert s2.mesh == s.mesh
+    assert s2.remat == "full"
+    assert s2.rules == s.rules
+
+
+def test_auto_strategy_prefers_fsdp_small_model():
+    s = auto_strategy(n_devices=8, param_count=100_000_000)
+    assert s.mesh.tensor == 1
+    assert s.mesh.fsdp == 8
+
+
+def test_auto_strategy_adds_tp_for_large_model():
+    s = auto_strategy(
+        n_devices=8, param_count=70_000_000_000, hbm_gb=16, devices_per_host=4
+    )
+    assert s.mesh.tensor > 1
+
+
+def test_auto_strategy_seq_axis_long_context():
+    s = auto_strategy(
+        n_devices=8, param_count=1_000_000_000, seq_len=131072, hbm_gb=16
+    )
+    assert s.mesh.seq > 1
+
+
+def _toy_problem():
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (16, 32)) * 0.02,
+            "w2": jax.random.normal(k2, (32, 16)) * 0.02,
+        }
+
+    axes = {"w1": ("embed", "mlp"), "w2": ("mlp", "embed")}
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        h = jax.nn.relu(x @ params["w1"].astype(x.dtype))
+        pred = h @ params["w2"].astype(x.dtype)
+        return jnp.mean((pred - y) ** 2)
+
+    return init_fn, axes, loss_fn
+
+
+@pytest.mark.parametrize(
+    "mesh_cfg",
+    [
+        MeshConfig(),  # pure DP over 8
+        MeshConfig(fsdp=4, tensor=2),
+        MeshConfig(data=2, fsdp=2, tensor=2),
+    ],
+)
+def test_auto_accelerate_strategies_train(mesh_cfg):
+    init_fn, axes, loss_fn = _toy_problem()
+    strategy = Strategy(
+        mesh=mesh_cfg, compute_dtype="float32", remat="none", donate=False
+    )
+    res = auto_accelerate(
+        loss_fn, init_fn, optax.sgd(0.1), axes, strategy=strategy
+    )
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 16), jnp.float32)
+    y = jnp.asarray(rng.randn(16, 16), jnp.float32)
+    state = res.state
+    losses = []
+    for _ in range(5):
+        state, metrics = res.train_step(state, (x, y), jax.random.key(0))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    # params sharded per strategy
+    w1_sharding = state.params["w1"].sharding
+    spec = w1_sharding.spec
+    if mesh_cfg.tensor == 2:
+        assert "tensor" in jax.tree.leaves(tuple(spec))
+
+
+def test_auto_accelerate_grad_accum_matches():
+    init_fn, axes, loss_fn = _toy_problem()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 16), jnp.float32)
+    y = jnp.asarray(rng.randn(16, 16), jnp.float32)
+
+    def run(accum):
+        strategy = Strategy(
+            mesh=MeshConfig(),
+            compute_dtype="float32",
+            remat="none",
+            grad_accum=accum,
+            donate=False,
+        )
+        res = auto_accelerate(
+            loss_fn, init_fn, optax.sgd(0.1), axes, strategy=strategy
+        )
+        state, _ = res.train_step(res.state, (x, y), jax.random.key(0))
+        return state.params
+
+    p1 = run(1)
+    p4 = run(4)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
