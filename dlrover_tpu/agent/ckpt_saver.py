@@ -26,7 +26,6 @@ import queue as _queue
 import signal
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from dlrover_tpu.common.constants import CheckpointConstant
@@ -193,12 +192,17 @@ def host_shard_filename(host_rank: int) -> str:
 
 
 def write_host_shard(storage, path: str, meta: CheckpointMeta, data) -> None:
+    """Stream header + meta + payload; ``data`` may be a memoryview into
+    shm — never copy the (multi-GB) payload into an intermediate blob."""
     meta_bytes = pickle.dumps(meta)
-    blob = bytearray()
-    blob += len(meta_bytes).to_bytes(_META_LEN_SIZE, "little")
-    blob += meta_bytes
-    blob += bytes(data)
-    storage.write(bytes(blob), path)
+    storage.write_parts(
+        [
+            len(meta_bytes).to_bytes(_META_LEN_SIZE, "little"),
+            meta_bytes,
+            data,
+        ],
+        path,
+    )
 
 
 def read_host_shard(path: str) -> tuple[CheckpointMeta, bytes] | None:
@@ -254,11 +258,6 @@ class AsyncCheckpointSaver:
         ]
         self._stopped = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._executor = ThreadPoolExecutor(
-            max_workers=max(local_shard_num, 1),
-            thread_name_prefix="ckpt-shard-saver",
-        )
-        self._persisted_steps: set[int] = set()
         self._last_persisted_step = -1
 
     # -- lifecycle ---------------------------------------------------------
@@ -377,7 +376,7 @@ class AsyncCheckpointSaver:
         """Persist one local shard, then run the commit protocol."""
         start = time.time()
         lock = self._shm_locks[local_rank]
-        acquired = lock.acquire(blocking=True)
+        acquired = self._acquire_or_take_over(lock)
         try:
             self._shm_handlers[local_rank].refresh()
             result = self._shm_handlers[local_rank].read()
@@ -393,7 +392,9 @@ class AsyncCheckpointSaver:
                 )
             step_dir = self._step_dir(event.path, meta.step)
             self._save_shard(step_dir, meta, data, local_rank)
-            self._commit_checkpoint(step_dir, meta.step, local_rank)
+            self._commit_checkpoint(
+                step_dir, meta.step, local_rank, engine=meta.engine
+            )
         finally:
             if acquired:
                 lock.release(force=True)
@@ -404,20 +405,44 @@ class AsyncCheckpointSaver:
             time.time() - start,
         )
 
+    def _acquire_or_take_over(
+        self, lock, timeout: float = 20.0
+    ) -> bool:
+        """Bounded acquire with forced takeover: a worker that died while
+        holding the shm lock must not deadlock the agent's breakpoint
+        flush (the exact crash Flash Checkpoint exists to survive)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if lock.acquire(blocking=False):
+                return True
+            time.sleep(0.2)
+        logger.warning(
+            "shm lock still held after %.0fs; assuming the holder died "
+            "and taking it over", timeout,
+        )
+        lock.release(force=True)
+        return lock.acquire(blocking=False)
+
     def _save_shard(self, step_dir, meta, data, local_rank):
         shard_id = self.host_rank * self.local_shard_num + local_rank
         path = os.path.join(step_dir, host_shard_filename(shard_id))
         write_host_shard(self._storage, path, meta, data)
 
-    def _commit_checkpoint(self, step_dir: str, step: int, local_rank):
-        """.done marker per shard; when all local shards + all nodes are
-        done, update the tracker file (reference commit_checkpoint :847)."""
+    def _commit_checkpoint(
+        self, step_dir: str, step: int, local_rank, engine: str = "sharded"
+    ):
+        """.done marker per shard; when all expected shards are done,
+        update the tracker file (reference commit_checkpoint :847)."""
         done_dir = os.path.join(step_dir, ".done")
         self._storage.safe_makedirs(done_dir)
         shard_id = self.host_rank * self.local_shard_num + local_rank
         self._storage.write("", os.path.join(done_dir, f"{shard_id}.done"))
-        # wait for every local shard of every host
-        total_shards = self.local_shard_num * self.num_hosts
+        # replicated engines write from host 0 only; sharded engines from
+        # every host
+        if engine == "replicated":
+            total_shards = self.local_shard_num
+        else:
+            total_shards = self.local_shard_num * self.num_hosts
         deadline = time.time() + CheckpointConstant.SAVE_TIMEOUT
         while time.time() < deadline:
             done = len(
@@ -445,11 +470,16 @@ class AsyncCheckpointSaver:
         # that does not exist yet.
         self._finalize_step_dir(step_dir)
         if self.host_rank == 0:
-            tracker = os.path.join(
-                self.checkpoint_dir or os.path.dirname(step_dir),
-                CheckpointConstant.TRACKER_FILE,
+            # the tracker must live NEXT TO the step dir it advertises —
+            # a custom event.path outside checkpoint_dir gets its own
+            # tracker there, not one in checkpoint_dir pointing nowhere
+            self._storage.write(
+                str(step),
+                os.path.join(
+                    os.path.dirname(step_dir),
+                    CheckpointConstant.TRACKER_FILE,
+                ),
             )
-            self._storage.write(str(step), tracker)
             self._storage.commit(step, True)
         self._last_persisted_step = step
 

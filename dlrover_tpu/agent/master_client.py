@@ -248,13 +248,27 @@ class MasterClient:
             )
         )
 
-    def check_ckpt_barrier(self, step: int, group: str, world: int) -> bool:
+    def check_ckpt_barrier(
+        self, step: int, group: str, world: int
+    ) -> tuple[bool, bool]:
+        """-> (passed, aborted)"""
         res: msg.BarrierResponse = self._get(
             msg.CheckpointReadyRequest(
                 node_id=self._node_id, step=step, group=group, world=world
             )
         )
-        return res.passed if res else False
+        if not res:
+            return False, False
+        return res.passed, getattr(res, "aborted", False)
+
+    def report_ckpt_skip(self, step: int, group: str) -> bool:
+        """Tell peers this host is sitting this save out."""
+        return self._report(
+            msg.CheckpointReadyRequest(
+                node_id=self._node_id, step=step, group=group,
+                ready=False,
+            )
+        )
 
     def sync_checkpoint(self, step: int) -> bool:
         return self._report(

@@ -349,6 +349,9 @@ class CheckpointReadyRequest(Message):
 @dataclass
 class BarrierResponse(Message):
     passed: bool = False
+    # a participant reported ready=False (e.g. shm lock busy): peers
+    # should stop waiting instead of burning the whole save timeout
+    aborted: bool = False
 
 
 # --------------------------------------------------------------------------

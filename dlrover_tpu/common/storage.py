@@ -78,6 +78,11 @@ class CheckpointStorage(ABC):
     def write(self, content, path: str):
         ...
 
+    def write_parts(self, parts, path: str):
+        """Write a sequence of byte-like chunks as one file without
+        concatenating them in memory (multi-GB checkpoint payloads)."""
+        self.write(b"".join(bytes(p) for p in parts), path)
+
     @abstractmethod
     def read(self, path: str, mode: str = "r"):
         ...
@@ -117,6 +122,16 @@ class PosixDiskStorage(CheckpointStorage):
         tmp = path + ".tmp"
         with open(tmp, mode) as f:
             f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def write_parts(self, parts, path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for part in parts:
+                f.write(part)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)

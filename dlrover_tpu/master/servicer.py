@@ -36,6 +36,9 @@ class CheckpointBarrierService:
         # (group, step) -> set of node ids that said ready (insertion
         # ordered: oldest evicted first)
         self._ready: dict[tuple[str, int], set[int]] = {}
+        # (group, step) entries some participant abandoned (lock busy):
+        # peers stop waiting immediately
+        self._aborted: dict[tuple[str, int], bool] = {}
         # node agreement that step shards were persisted
         self._persisted: dict[int, set[int]] = {}
 
@@ -43,16 +46,29 @@ class CheckpointBarrierService:
         while len(d) > self.MAX_ENTRIES:
             d.pop(next(iter(d)))
 
-    def report_ready(self, group: str, step: int, node_id: int, world: int):
+    def report_ready(
+        self, group: str, step: int, node_id: int, world: int,
+        ready: bool = True,
+    ):
         with self._lock:
+            if not ready:
+                self._aborted[(group, step)] = True
+                self._evict(self._aborted)
+                return False
             members = self._ready.setdefault((group, step), set())
             members.add(node_id)
             self._evict(self._ready)
             return len(members) >= world
 
-    def check_ready(self, group: str, step: int, world: int) -> bool:
+    def check_ready(self, group: str, step: int, world: int):
+        """-> (passed, aborted)"""
         with self._lock:
-            return len(self._ready.get((group, step), set())) >= world
+            if self._aborted.get((group, step)):
+                return False, True
+            return (
+                len(self._ready.get((group, step), set())) >= world,
+                False,
+            )
 
     def sync_checkpoint(self, step: int, node_id: int, world: int) -> bool:
         with self._lock:
@@ -133,10 +149,10 @@ class MasterServicer(RpcService):
         if isinstance(message, msg.ParallelConfigRequest):
             return self._get_paral_config(node_type, node_id)
         if isinstance(message, msg.CheckpointReadyRequest):
-            passed = self.ckpt_barrier.check_ready(
+            passed, aborted = self.ckpt_barrier.check_ready(
                 message.group, message.step, message.world
             )
-            return msg.BarrierResponse(passed=passed)
+            return msg.BarrierResponse(passed=passed, aborted=aborted)
         if isinstance(message, msg.ElasticRunConfigRequest):
             return msg.ElasticRunConfig(configs=dict(self._run_configs))
         if isinstance(message, msg.SyncBarrierRequest):
@@ -219,7 +235,8 @@ class MasterServicer(RpcService):
             return self.sync_service.notify_barrier(message.sync_name)
         if isinstance(message, msg.CheckpointReadyRequest):
             return self.ckpt_barrier.report_ready(
-                message.group, message.step, message.node_id, message.world
+                message.group, message.step, message.node_id, message.world,
+                ready=message.ready,
             )
         if isinstance(message, msg.CheckpointSyncRequest):
             world = self._alive_worker_num()

@@ -1,0 +1,18 @@
+"""Model zoo: TPU-first reference models used by the trainer, benches and
+the auto_accelerate strategy tests.
+
+Equivalent capability: the reference accelerates HF models (Llama/GPT2/
+GLM/Bert attention swaps, atorch/atorch/modules/transformer/layers.py) and
+ships Llama-2 examples (atorch/examples/llama2). TPU redesign: a native
+functional decoder (scan-over-layers, logical sharding axes, flash
+attention) rather than module injection into torch models.
+"""
+
+from dlrover_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    llama_logical_axes,
+    llama_init,
+    llama_apply,
+    llama_loss_fn,
+    PRESETS,
+)

@@ -1,0 +1,288 @@
+"""LLaMA-family decoder, TPU-first.
+
+Pure functional JAX (params are a plain pytree): RMSNorm, RoPE, GQA,
+SwiGLU, untied LM head. Layers are *stacked* along a leading axis and the
+forward is a ``lax.scan`` over them — one compiled layer body regardless
+of depth (fast compiles, XLA-friendly), with ``jax.checkpoint`` applied to
+the scanned body for rematerialisation.
+
+Attention is the Pallas flash kernel (dlrover_tpu/ops/attention.py) on
+TPU; set ``attn_impl="reference"`` for tiny CPU test shapes where the
+plain einsum is faster than interpret mode.
+
+Sharding: every param carries logical axis names (see
+``llama_logical_axes``); the parallel layer maps them onto the mesh
+(fsdp/tensor/seq/...). Reference parity: this is the flagship-model role
+played by atorch's Llama-2 examples (atorch/examples/llama2/) and the HF
+attention swaps (atorch/atorch/modules/transformer/layers.py:1354
+LlamaAttentionFA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.ops.attention import flash_attention, mha_reference
+from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+from dlrover_tpu.parallel.sharding import shard_logical
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    mlp_dim: int = 11008
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"          # activation/compute dtype
+    attn_impl: str = "flash"         # "flash" | "reference"
+    remat: bool = True               # checkpoint each scanned layer
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def param_count(self) -> int:
+        d, v, h = self.dim, self.vocab_size, self.head_dim
+        per_layer = (
+            d * self.n_heads * h            # wq
+            + 2 * d * self.n_kv_heads * h   # wk, wv
+            + self.n_heads * h * d          # wo
+            + 3 * d * self.mlp_dim          # gate, up, down
+            + 2 * d                         # norms
+        )
+        return v * d * 2 + d + self.n_layers * per_layer
+
+
+PRESETS = {
+    "tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=128, max_seq_len=128, attn_impl="reference", remat=False,
+        dtype="float32",
+    ),
+    "nano-350m": LlamaConfig(
+        vocab_size=32000, dim=1024, n_layers=16, n_heads=16, n_kv_heads=16,
+        mlp_dim=2816, max_seq_len=2048,
+    ),
+    "llama2-1b": LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=16,
+        mlp_dim=5504, max_seq_len=2048,
+    ),
+    "llama2-7b": LlamaConfig(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+        mlp_dim=11008, max_seq_len=4096,
+    ),
+    "llama3-8b": LlamaConfig(
+        vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        mlp_dim=14336, max_seq_len=8192, rope_theta=500000.0,
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def llama_init(config: LlamaConfig, rng) -> dict:
+    """Initialise params (fp32 masters); layer params stacked on axis 0."""
+    d, h, hd = config.dim, config.n_heads, config.head_dim
+    kvh, m, L = config.n_kv_heads, config.mlp_dim, config.n_layers
+    keys = jax.random.split(rng, 8)
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5))
+
+    return {
+        "embed": jax.random.normal(keys[0], (config.vocab_size, d)) * 0.02,
+        "layers": {
+            "attn_norm": jnp.ones((L, d)),
+            "wq": norm_init(keys[1], (L, d, h * hd), d),
+            "wk": norm_init(keys[2], (L, d, kvh * hd), d),
+            "wv": norm_init(keys[3], (L, d, kvh * hd), d),
+            "wo": norm_init(keys[4], (L, h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((L, d)),
+            "w_gate": norm_init(keys[5], (L, d, m), d),
+            "w_up": norm_init(keys[6], (L, d, m), d),
+            "w_down": norm_init(keys[7], (L, m, d), m),
+        },
+        "final_norm": jnp.ones((d,)),
+        "lm_head": jax.random.normal(keys[0], (d, config.vocab_size)) * 0.02,
+    }
+
+
+def llama_logical_axes(config: LlamaConfig) -> dict:
+    """Logical sharding names matching the ``llama_init`` tree."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layer", "embed"),
+            "wq": ("layer", "embed", "heads"),
+            "wk": ("layer", "embed", "kv_heads"),
+            "wv": ("layer", "embed", "kv_heads"),
+            "wo": ("layer", "heads", "embed"),
+            "mlp_norm": ("layer", "embed"),
+            "w_gate": ("layer", "embed", "mlp"),
+            "w_up": ("layer", "embed", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    normed = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
+    return normed * scale.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """x: [B, S, H, Dh]; rotate pairs (first half, second half)."""
+    half = x.shape[-1] // 2
+    freqs = jnp.exp(
+        -jnp.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def _sharded_flash(config: LlamaConfig, qt, kt, vt):
+    """pallas_call does not auto-partition under GSPMD: without an explicit
+    shard_map, jit would all-gather q/k/v to run the kernel replicated.
+    Map the kernel over the mesh's batch/head axes (seq stays local here —
+    the seq axis is the ring-attention path, parallel/ring_attention.py).
+    """
+    from dlrover_tpu.parallel.mesh import get_mesh
+    from dlrover_tpu.parallel.sharding import logical_to_mesh_axes
+
+    def kernel(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True,
+            block_q=config.attn_block_q, block_k=config.attn_block_k,
+        )
+
+    try:
+        mesh = get_mesh()
+    except RuntimeError:
+        mesh = None
+    if mesh is None or all(
+        mesh.shape[a] == 1 for a in ("data", "fsdp", "tensor")
+    ):
+        return kernel(qt, kt, vt)
+
+    rules = (
+        ("batch", ("data", "fsdp")),
+        ("heads", "tensor"),
+        ("kv_heads", "tensor"),
+    )
+    q_spec = logical_to_mesh_axes(
+        ("batch", "heads", None, None), rules)
+    kv_spec = logical_to_mesh_axes(
+        ("batch", "kv_heads", None, None), rules)
+    return jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )(qt, kt, vt)
+
+
+def _attention(config: LlamaConfig, q, k, v):
+    """q: [B,S,H,Dh], k/v: [B,S,KVH,Dh] -> [B,S,H,Dh]."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    qt = shard_logical(qt, ("batch", "heads", "seq", "head_dim"))
+    kt = shard_logical(kt, ("batch", "kv_heads", "seq", "head_dim"))
+    vt = shard_logical(vt, ("batch", "kv_heads", "seq", "head_dim"))
+    if config.attn_impl == "flash":
+        out = _sharded_flash(config, qt, kt, vt)
+    else:
+        out = mha_reference(qt, kt, vt, causal=True)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _layer(config: LlamaConfig, x, layer_params, positions):
+    """One transformer block. x: [B,S,D]."""
+    p = layer_params
+    dtype = x.dtype
+    B, S, D = x.shape
+    h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
+
+    y = _rms_norm(x, p["attn_norm"], config.norm_eps)
+    q = (y @ p["wq"].astype(dtype)).reshape(B, S, h, hd)
+    k = (y @ p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
+    v = (y @ p["wv"].astype(dtype)).reshape(B, S, kvh, hd)
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+    attn = _attention(config, q, k, v).reshape(B, S, h * hd)
+    x = x + attn @ p["wo"].astype(dtype)
+    x = shard_logical(x, ("batch", "seq", "embed"))
+
+    y = _rms_norm(x, p["mlp_norm"], config.norm_eps)
+    gate = jax.nn.silu(y @ p["w_gate"].astype(dtype))
+    up = y @ p["w_up"].astype(dtype)
+    mlp = shard_logical(gate * up, ("batch", "seq", "mlp"))
+    x = x + mlp @ p["w_down"].astype(dtype)
+    return shard_logical(x, ("batch", "seq", "embed"))
+
+
+def llama_apply(config: LlamaConfig, params, tokens, positions=None):
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+    dtype = jnp.dtype(config.dtype)
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    x = params["embed"].astype(dtype)[tokens]
+    x = shard_logical(x, ("batch", "seq", "embed"))
+
+    def body(carry, layer_params):
+        out = _layer(config, carry, layer_params, positions)
+        return out, None
+
+    if config.remat:
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = _rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = x @ params["lm_head"].astype(dtype)
+    logits = shard_logical(logits, ("batch", "seq", "vocab"))
+    return logits.astype(jnp.float32)
+
+
+def llama_loss_fn(config: LlamaConfig):
+    """Next-token CE loss closure for auto_accelerate."""
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        logits = llama_apply(config, params, tokens[:, :-1])
+        labels = tokens[:, 1:]
+        loss, valid = softmax_cross_entropy(logits, labels)
+        return loss.sum() / jnp.maximum(valid.sum(), 1)
+
+    return loss_fn

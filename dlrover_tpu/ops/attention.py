@@ -28,6 +28,45 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _block_mask(shape, i, j, *, block_q, block_k, causal, q_len, kv_len):
+    """Validity mask for a (block_q, block_k) score tile.
+
+    Causality is end-aligned (offset = kv_len - q_len), matching
+    mha_reference's tril(k_len - q_len); rows/cols beyond the true
+    lengths are masked so non-block-multiple shapes stay exact.
+    Returns None when every position is trivially valid."""
+    pad_rows = q_len % block_q != 0
+    pad_cols = kv_len % block_k != 0
+    if not (causal or pad_rows or pad_cols):
+        return None
+    rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + i * block_q
+    cols = jax.lax.broadcasted_iota(jnp.int32, shape, 1) + j * block_k
+    mask = None
+
+    def conj(m, new):
+        return new if m is None else m & new
+
+    if pad_rows:
+        mask = conj(mask, rows < q_len)
+    if pad_cols:
+        mask = conj(mask, cols < kv_len)
+    if causal:
+        mask = conj(mask, (kv_len - q_len) + rows >= cols)
+    return mask
+
+
+def _zero_pad_rows(x, block_idx, block_size, true_len):
+    """Zero rows of a [block, d] tile that lie beyond ``true_len``.
+
+    Out-of-bounds block padding is undefined (NaN in interpret mode) and
+    0*NaN == NaN, so masked probabilities alone cannot keep garbage out
+    of the MXU contractions — the operand tails must be zeroed."""
+    if true_len % block_size == 0:
+        return x
+    rows = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(rows + block_idx * block_size < true_len, x, 0)
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -47,7 +86,7 @@ def _compiler_params(dims):
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, block_q, block_k, num_kv_blocks,
+    *, sm_scale, causal, block_q, block_k, num_kv_blocks, q_len, kv_len,
 ):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -58,28 +97,34 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = (j * block_k < (i + 1) * block_q) if causal else (j >= 0)
+    offset = kv_len - q_len
+    run = (j * block_k < offset + (i + 1) * block_q) if causal else (j >= 0)
 
     @pl.when(run)
     def _body():
         q = q_ref[0, 0]
-        k = k_ref[0, 0]
+        k = _zero_pad_rows(k_ref[0, 0], j, block_k, kv_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = (i * block_q + rows) >= (j * block_k + cols)
+        mask = _block_mask(
+            s.shape, i, j, block_q=block_q, block_k=block_k,
+            causal=causal, q_len=q_len, kv_len=kv_len,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        if mask is not None:
+            # explicit zeroing: a fully-masked row has m_new == NEG_INF
+            # and exp(s - m_new) == 1 would pollute l
+            p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0, 0]
+        v = _zero_pad_rows(v_ref[0, 0], j, block_k, kv_len)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -112,6 +157,8 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         block_q=block_q,
         block_k=block_k,
         num_kv_blocks=grid[3],
+        q_len=q_len,
+        kv_len=kv_len,
     )
     out_shape = (
         jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -156,7 +203,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_scr,
-    *, sm_scale, causal, block_q, block_k, num_kv_blocks,
+    *, sm_scale, causal, block_q, block_k, num_kv_blocks, q_len, kv_len,
 ):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -165,13 +212,14 @@ def _bwd_dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (j * block_k < (i + 1) * block_q) if causal else (j >= 0)
+    offset = kv_len - q_len
+    run = (j * block_k < offset + (i + 1) * block_q) if causal else (j >= 0)
 
     @pl.when(run)
     def _body():
         q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
+        k = _zero_pad_rows(k_ref[0, 0], j, block_k, kv_len)
+        v = _zero_pad_rows(v_ref[0, 0], j, block_k, kv_len)
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
@@ -179,12 +227,15 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = (i * block_q + rows) >= (j * block_k + cols)
+        mask = _block_mask(
+            s.shape, i, j, block_q=block_q, block_k=block_k,
+            causal=causal, q_len=q_len, kv_len=kv_len,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -203,7 +254,7 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, sm_scale, causal, block_q, block_k, num_q_blocks,
+    *, sm_scale, causal, block_q, block_k, num_q_blocks, q_len, kv_len,
 ):
     j = pl.program_id(2)  # kv block
     i = pl.program_id(3)  # q block (innermost: accumulate over q)
@@ -213,26 +264,30 @@ def _bwd_dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = ((i + 1) * block_q > j * block_k) if causal else (i >= 0)
+    offset = kv_len - q_len
+    run = (offset + (i + 1) * block_q > j * block_k) if causal else (i >= 0)
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0]
+        q = _zero_pad_rows(q_ref[0, 0], i, block_q, q_len)
         k = k_ref[0, 0]
         v = v_ref[0, 0]
-        do = do_ref[0, 0]
+        do = _zero_pad_rows(do_ref[0, 0], i, block_q, q_len)
         lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        delta = _zero_pad_rows(delta_ref[0, 0], i, block_q, q_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = (i * block_q + rows) >= (j * block_k + cols)
+        mask = _block_mask(
+            s.shape, i, j, block_q=block_q, block_k=block_k,
+            causal=causal, q_len=q_len, kv_len=kv_len,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         # dv += p^T do
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -279,6 +334,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kv_blocks=nk,
+            q_len=q_len, kv_len=kv_len,
         ),
         grid=(batch, heads, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
@@ -304,6 +360,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q_blocks=nq,
+            q_len=q_len, kv_len=kv_len,
         ),
         grid=(batch, heads, nk, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],

@@ -142,21 +142,36 @@ def auto_accelerate(
         is_leaf=lambda s: isinstance(s, PartitionSpec),
     )
 
-    # Optimizer state shardings: leaves that mirror a param take its
-    # sharding; scalars (counts, schedules) replicate. We discover the
-    # correspondence structurally via eval_shape.
+    # Optimizer state shardings: subtrees that mirror the params pytree
+    # (optax mu/nu/trace/...) take the param shardings element-wise;
+    # everything else (counts, schedules) replicates. Structural matching
+    # avoids collisions between same-shaped params with different layouts.
     abstract_params = jax.eval_shape(init_fn, jax.random.key(seed))
     abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
-    param_leaves = jax.tree.leaves(param_shardings)
-    shape_to_sharding = {}
-    for leaf, sh in zip(jax.tree.leaves(abstract_params), param_leaves):
-        shape_to_sharding.setdefault((leaf.shape, leaf.dtype), sh)
+    params_struct = jax.tree.structure(abstract_params)
+    abstract_param_leaves = jax.tree.leaves(abstract_params)
     replicated = NamedSharding(mesh, PartitionSpec())
 
-    def opt_leaf_sharding(leaf):
-        return shape_to_sharding.get((leaf.shape, leaf.dtype), replicated)
+    def _is_param_tree(sub):
+        try:
+            if jax.tree.structure(sub) != params_struct:
+                return False
+            leaves = jax.tree.leaves(sub)
+        except Exception:  # noqa: BLE001 - exotic nodes: not a match
+            return False
+        return all(
+            getattr(l, "shape", None) == p.shape
+            and getattr(l, "dtype", None) == p.dtype
+            for l, p in zip(leaves, abstract_param_leaves)
+        )
 
-    opt_shardings = jax.tree.map(opt_leaf_sharding, abstract_opt)
+    opt_shardings = jax.tree.map(
+        lambda sub: param_shardings if _is_param_tree(sub) else (
+            jax.tree.map(lambda _: replicated, sub)
+        ),
+        abstract_opt,
+        is_leaf=_is_param_tree,
+    )
     state_shardings = TrainState(
         step=replicated, params=param_shardings, opt_state=opt_shardings
     )
@@ -193,30 +208,55 @@ def auto_accelerate(
         )
         return loss, aux, grads
 
+    def _shard_batch_leaf(x):
+        ndim = getattr(x, "ndim", None)
+        if ndim is None:
+            return x
+        if ndim >= len(batch_logical_axes):
+            axes = tuple(batch_logical_axes) + (None,) * (
+                ndim - len(batch_logical_axes)
+            )
+        else:
+            # lower-rank leaf (lengths, weights): shard the batch dim only
+            axes = (batch_logical_axes[0],) + (None,) * (ndim - 1)
+        return shard_logical(x, axes, rules)
+
     def train_step(state: TrainState, batch, rng):
-        batch = jax.tree.map(
-            lambda x: shard_logical(x, batch_logical_axes, rules), batch
-        )
+        batch = jax.tree.map(_shard_batch_leaf, batch)
         if accum == 1:
             loss, aux, grads = microbatch_grads(state.params, batch, rng)
         else:
             def split(x):
-                return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+                if getattr(x, "ndim", 0) < 1 or x.shape[0] % accum:
+                    raise ValueError(
+                        f"batch dim {getattr(x, 'shape', ())} not divisible "
+                        f"by grad_accum={accum}"
+                    )
+                mb = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+                # keep microbatches sharded like the batch (avoids an SPMD
+                # full-remat on the reshape)
+                return shard_logical(
+                    mb, (None,) + tuple(batch_logical_axes), rules
+                )
 
             micro = jax.tree.map(split, batch)
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)
 
-            def body(carry, mb):
+            def body(carry, inp):
                 g_acc, l_acc = carry
-                loss, _aux, grads = microbatch_grads(state.params, mb, rng)
+                mb, idx = inp
+                mb_rng = jax.random.fold_in(rng, idx)
+                loss, aux, grads = microbatch_grads(state.params, mb, mb_rng)
                 g_acc = jax.tree.map(jnp.add, g_acc, grads)
-                return (g_acc, l_acc + loss), None
+                return (g_acc, l_acc + loss), aux
 
-            (grads, loss_sum), _ = jax.lax.scan(
-                body, (zero_grads, jnp.zeros(())), micro
+            (grads, loss_sum), aux_stack = jax.lax.scan(
+                body, (zero_grads, jnp.zeros(())),
+                (micro, jnp.arange(accum)),
             )
             grads = jax.tree.map(lambda g: g / accum, grads)
-            loss, aux = loss_sum / accum, {}
+            loss = loss_sum / accum
+            aux = jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stack)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

@@ -29,6 +29,7 @@ DEFAULT_RULES: LogicalRules = (
     ("expert", "expert"),
     ("head_dim", None),
     ("kv", None),
+    ("layer", None),
     ("stage", "pipe"),
 )
 
@@ -78,12 +79,22 @@ def logical_sharding(
 
 
 def shard_logical(x, logical_axes, rules: Optional[LogicalRules] = None):
-    """``with_sharding_constraint`` by logical names, inside jit."""
-    import jax
+    """``with_sharding_constraint`` by logical names, inside jit.
 
-    return jax.lax.with_sharding_constraint(
-        x, logical_to_mesh_axes(logical_axes, rules)
-    )
+    No-op when no mesh is active (single-device/unit-test use)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from dlrover_tpu.parallel.mesh import get_mesh
+
+    try:
+        mesh = get_mesh()
+    except RuntimeError:
+        return x
+    if mesh.empty:
+        return x
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def unsharded(mesh=None):

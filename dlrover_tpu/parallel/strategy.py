@@ -136,7 +136,7 @@ def auto_strategy(
     mesh = MeshConfig(
         pipe=1, data=1, fsdp=fsdp, expert=expert, seq=seq, tensor=tensor
     )
-    remat = _remat_for(param_bytes * 4 / n_devices, hbm)
+    remat = _remat_for(param_bytes / n_devices, hbm)
     strategy = Strategy(mesh=mesh, remat=remat)
     logger.info("auto_strategy: %s", strategy.describe())
     return strategy

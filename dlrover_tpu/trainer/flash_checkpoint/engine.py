@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 import pickle
 import queue as _queue
+import threading
 import time
 
 import numpy as np
@@ -113,6 +114,7 @@ class CheckpointEngine:
         self._save_timeout = save_timeout
         self._shm_handler = SharedMemoryHandler(local_rank)
         self._latest_step = 0
+        self._async_thread: threading.Thread | None = None
         # Under tpu-run the agent hosts the saver (factory queue); when
         # used standalone (plain `python train.py`) the engine runs its
         # own in-process saver so the API still works.
@@ -171,18 +173,33 @@ class CheckpointEngine:
 
     def _all_hosts_ready(self, step: int) -> bool:
         """Host-side readiness barrier via the master (replaces the
-        reference's device collective, engine.py:51)."""
+        reference's device collective, engine.py:51). Bails out early if
+        any peer reported a skip for this step."""
         if self._client is None or self._num_hosts <= 1:
             return True
         self._client.report_ckpt_ready(step, "save", self._num_hosts)
         deadline = time.time() + self._save_timeout
         while time.time() < deadline:
-            if self._client.check_ckpt_barrier(
+            passed, aborted = self._client.check_ckpt_barrier(
                 step, "save", self._num_hosts
-            ):
+            )
+            if passed:
                 return True
+            if aborted:
+                logger.warning(
+                    "peer skipped ckpt save at step %s; aborting barrier",
+                    step,
+                )
+                return False
             time.sleep(0.1)
         return False
+
+    def _report_skip(self, step: int):
+        if self._client is not None and self._num_hosts > 1:
+            try:
+                self._client.report_ckpt_skip(step, "save")
+            except Exception:  # noqa: BLE001 - best effort
+                logger.warning("could not report ckpt skip for %s", step)
 
     # ---------------------------------------------------------- save paths
 
@@ -191,60 +208,67 @@ class CheckpointEngine:
         per engine."""
         raise NotImplementedError
 
+    def _write_shm_locked(self, step: int, state_dict) -> int:
+        """D2H-copy the selected shards and write them into shm. Caller
+        holds the shm lock. Returns total bytes written."""
+        import jax
+
+        names, leaves, _treedef = _tree_flatten_with_names(state_dict)
+        # Launch every D2H transfer before touching any bytes.
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                leaf.copy_to_host_async()
+        metas: list[LeafMeta] = []
+        offset = 0
+        shard_arrays = []
+        for name, leaf in zip(names, leaves):
+            for index, data in self._select_shards(leaf):
+                host_arr = np.asarray(data)
+                meta = LeafMeta(
+                    path=name,
+                    dtype=str(host_arr.dtype),
+                    shape=tuple(host_arr.shape),
+                    offset=offset,
+                    nbytes=host_arr.nbytes,
+                    global_shape=tuple(np.shape(leaf)),
+                    index=_index_to_meta(index, host_arr.ndim),
+                )
+                metas.append(meta)
+                shard_arrays.append(host_arr)
+                offset += host_arr.nbytes
+        ckpt_meta = CheckpointMeta(
+            step=step,
+            leaves=metas,
+            treedef=b"",
+            engine=self.engine_name,
+            host_rank=self._host_rank,
+            num_hosts=self._num_hosts,
+            total_bytes=offset,
+        )
+        buf = self._shm_handler.write_meta_and_reserve(ckpt_meta)
+        for meta, host_arr in zip(metas, shard_arrays):
+            dst = np.frombuffer(
+                buf, dtype=np.uint8, count=meta.nbytes, offset=meta.offset
+            )
+            np.copyto(dst, host_arr.reshape(-1).view(np.uint8))
+        self._latest_step = step
+        return offset
+
     def save_to_memory(self, step: int, state_dict) -> bool:
         """Write the state into shm; ~the only blocking time the training
         loop sees. Returns False if skipped (saver busy)."""
-        import jax
-
         start = time.time()
         if not self._shm_lock.acquire(blocking=False):
             logger.warning(
                 "skip shm save at step %s: previous persist in flight", step
             )
+            self._report_skip(step)
             return False
         try:
             if not self._all_hosts_ready(step):
                 logger.warning("ckpt readiness barrier failed at %s", step)
                 return False
-            names, leaves, treedef = _tree_flatten_with_names(state_dict)
-            # Launch every D2H transfer before touching any bytes.
-            for leaf in leaves:
-                if isinstance(leaf, jax.Array):
-                    leaf.copy_to_host_async()
-            metas: list[LeafMeta] = []
-            offset = 0
-            shard_arrays = []
-            for name, leaf in zip(names, leaves):
-                for index, data in self._select_shards(leaf):
-                    host_arr = np.asarray(data)
-                    meta = LeafMeta(
-                        path=name,
-                        dtype=str(host_arr.dtype),
-                        shape=tuple(host_arr.shape),
-                        offset=offset,
-                        nbytes=host_arr.nbytes,
-                        global_shape=tuple(np.shape(leaf)),
-                        index=_index_to_meta(index, host_arr.ndim),
-                    )
-                    metas.append(meta)
-                    shard_arrays.append(host_arr)
-                    offset += host_arr.nbytes
-            ckpt_meta = CheckpointMeta(
-                step=step,
-                leaves=metas,
-                treedef=b"",
-                engine=self.engine_name,
-                host_rank=self._host_rank,
-                num_hosts=self._num_hosts,
-                total_bytes=offset,
-            )
-            buf = self._shm_handler.write_meta_and_reserve(ckpt_meta)
-            for meta, host_arr in zip(metas, shard_arrays):
-                dst = np.frombuffer(
-                    buf, dtype=np.uint8, count=meta.nbytes, offset=meta.offset
-                )
-                np.copyto(dst, host_arr.reshape(-1).view(np.uint8))
-            self._latest_step = step
+            offset = self._write_shm_locked(step, state_dict)
         finally:
             self._shm_lock.release()
         self._notify(SaveEvent(step=step, storage_type="memory"))
@@ -255,6 +279,76 @@ class CheckpointEngine:
             offset / 1e6,
         )
         return True
+
+    def save_to_memory_async(
+        self, step: int, state_dict, storage_path: str | None = None
+    ) -> bool:
+        """Non-blocking save: dispatch the HBM->host transfers and hand the
+        shm write to a copier thread; the training loop only pays the
+        dispatch cost.
+
+        The TPU-native improvement over the reference (whose
+        save_state_dict_to_memory blocks on the D2H copy, engine.py:284):
+        XLA async dispatch lets the device keep computing while buffers
+        drain to the host. CONTRACT: the caller must keep ``state_dict``'s
+        arrays alive (no donation of these exact buffers) until
+        :meth:`wait_for_shm_save` returns — the Trainer passes the
+        *previous* step's state for exactly this reason.
+        """
+        import jax
+
+        if self._async_thread is not None and self._async_thread.is_alive():
+            logger.warning("skip async save %s: previous still running", step)
+            self._report_skip(step)
+            return False
+        if not self._shm_lock.acquire(blocking=False):
+            logger.warning("skip async save %s: shm lock busy", step)
+            self._report_skip(step)
+            return False
+        try:
+            if not self._all_hosts_ready(step):
+                logger.warning("ckpt readiness barrier failed at %s", step)
+                self._shm_lock.release()
+                return False
+            _names, leaves, _ = _tree_flatten_with_names(state_dict)
+            for leaf in leaves:
+                if isinstance(leaf, jax.Array):
+                    leaf.copy_to_host_async()
+        except BaseException:
+            self._shm_lock.release()
+            raise
+
+        def _finish():
+            start = time.time()
+            try:
+                offset = self._write_shm_locked(step, state_dict)
+            finally:
+                self._shm_lock.release()
+            self._notify(SaveEvent(step=step, storage_type="memory"))
+            if storage_path is not None:
+                self._notify(
+                    SaveEvent(
+                        step=step, path=storage_path, storage_type="disk"
+                    )
+                )
+            logger.info(
+                "async-saved step %s to shm in %.3fs (%.1f MB)",
+                step, time.time() - start, offset / 1e6,
+            )
+
+        self._async_thread = threading.Thread(
+            target=_finish, name=f"ckpt-shm-copier-{step}", daemon=True
+        )
+        self._async_thread.start()
+        return True
+
+    def wait_for_shm_save(self, timeout: float | None = None) -> bool:
+        """Join the in-flight async shm write (flush before restart)."""
+        t = self._async_thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
     def save_to_storage(self, step: int, state_dict, path: str = "") -> bool:
         """Shm write (blocking) + async persistence in the agent."""
@@ -328,6 +422,12 @@ class CheckpointEngine:
                     "back to storage"
                 )
                 return None
+        if not _covers_global(leaf_map):
+            logger.info(
+                "shm shards do not cover the global arrays (multi-host "
+                "state); falling back to storage"
+            )
+            return None
         state = _assemble(leaf_map)
         logger.info("restored step %s from shared memory", meta.step)
         return _fill_target(state, target, meta.step)
@@ -356,6 +456,12 @@ class CheckpointEngine:
                 leaf_map.setdefault(leaf.path, []).append((leaf, arr))
         if not leaf_map:
             return None
+        if not _covers_global(leaf_map):
+            logger.warning(
+                "checkpoint at %s is missing shards; refusing a partial "
+                "restore", step_dir,
+            )
+            return None
         state = _assemble(leaf_map)
         logger.info("restored step %s from %s", step, step_dir)
         return _fill_target(state, target, step)
@@ -383,6 +489,22 @@ def _count(shape) -> int:
     for s in shape:
         n *= s
     return n
+
+
+def _covers_global(leaf_map) -> bool:
+    """Every leaf's pieces must tile its full global shape (pieces are
+    non-overlapping unique shards, so volumes may be summed)."""
+    for _name, pieces in leaf_map.items():
+        meta0 = pieces[0][0]
+        if meta0.index is None or tuple(meta0.shape) == tuple(
+            meta0.global_shape
+        ):
+            continue
+        total = _count(meta0.global_shape)
+        have = sum(_count(m.shape) for m, _ in pieces)
+        if have < total:
+            return False
+    return True
 
 
 def _assemble(leaf_map) -> dict:

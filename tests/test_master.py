@@ -182,11 +182,25 @@ class TestKVStoreAndBarrier:
         c0 = make_client(local_master, 0)
         c1 = make_client(local_master, 1)
         try:
-            assert not c0.check_ckpt_barrier(10, "g", world=2)
+            assert c0.check_ckpt_barrier(10, "g", world=2) == (False, False)
             c0.report_ckpt_ready(10, "g", world=2)
-            assert not c0.check_ckpt_barrier(10, "g", world=2)
+            assert c0.check_ckpt_barrier(10, "g", world=2) == (False, False)
             c1.report_ckpt_ready(10, "g", world=2)
-            assert c0.check_ckpt_barrier(10, "g", world=2)
+            assert c0.check_ckpt_barrier(10, "g", world=2) == (True, False)
+        finally:
+            c0.close()
+            c1.close()
+
+    def test_ckpt_barrier_abort_on_skip(self, local_master):
+        """A host that sits a save out must fail the barrier fast for its
+        peers instead of letting them wait out the whole timeout."""
+        c0 = make_client(local_master, 0)
+        c1 = make_client(local_master, 1)
+        try:
+            c0.report_ckpt_ready(11, "g", world=2)
+            c1.report_ckpt_skip(11, "g")
+            passed, aborted = c0.check_ckpt_barrier(11, "g", world=2)
+            assert not passed and aborted
         finally:
             c0.close()
             c1.close()

@@ -113,3 +113,44 @@ def test_quantize_stochastic_unbiased():
     out = dequantize_int8(q, scales, shape)
     # stochastic rounding preserves the mean
     assert abs(float(out.mean()) - 0.35) < 5e-3
+
+
+def test_flash_attention_unequal_lengths_end_aligned_causal():
+    """Decode-style q_len < kv_len: causality must be end-aligned."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 2, 16, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 128, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 128, 64), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=64)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+@pytest.mark.parametrize("q_len,kv_len", [(100, 100), (96, 200)])
+def test_flash_attention_non_block_multiple_lengths(q_len, kv_len):
+    """Padded tail rows/cols must not pollute the softmax."""
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, 2, q_len, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, kv_len, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, kv_len, 64), jnp.float32)
+    for causal in (True, False):
+        out = flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=64
+        )
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-2
+        )
+    g = jax.grad(
+        lambda *a: jnp.sum(
+            flash_attention(*a, causal=True, block_q=64, block_k=64) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda *a: jnp.sum(mha_reference(*a, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, gr):
+        scale = float(jnp.max(jnp.abs(b))) + 1e-6
+        assert float(jnp.max(jnp.abs(a - b))) / scale < 5e-2
