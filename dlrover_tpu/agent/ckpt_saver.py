@@ -44,6 +44,17 @@ _META_LEN_SIZE = 8
 SAVER_FACTORY_QUEUE = "ckpt_factory"
 
 
+def _pid_alive(pid: str) -> bool:
+    """True if the process that acquired a SharedLock still exists."""
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except (ValueError, ProcessLookupError):
+        return False
+    except PermissionError:
+        return True
+
+
 def shm_name(local_rank: int = 0) -> str:
     job = os.environ.get("ELASTIC_JOB_NAME", "local")
     return f"dlrtpu_ckpt_{job}_{local_rank}"
@@ -377,6 +388,15 @@ class AsyncCheckpointSaver:
         start = time.time()
         lock = self._shm_locks[local_rank]
         acquired = self._acquire_or_take_over(lock)
+        if not acquired:
+            # never read shm unlocked: a live writer may be mid-copy and
+            # we would persist (and advertise) a torn checkpoint
+            logger.error(
+                "skipping persist of step %s shard %d: shm lock unavailable",
+                event.step,
+                local_rank,
+            )
+            return
         try:
             self._shm_handlers[local_rank].refresh()
             result = self._shm_handlers[local_rank].read()
@@ -406,22 +426,40 @@ class AsyncCheckpointSaver:
         )
 
     def _acquire_or_take_over(
-        self, lock, timeout: float = 20.0
+        self, lock, dead_grace: float = 2.0
     ) -> bool:
-        """Bounded acquire with forced takeover: a worker that died while
-        holding the shm lock must not deadlock the agent's breakpoint
-        flush (the exact crash Flash Checkpoint exists to survive)."""
-        deadline = time.time() + timeout
+        """Bounded acquire with forced takeover ONLY from a dead holder.
+
+        A worker that died while holding the shm lock must not deadlock
+        the agent's breakpoint flush (the exact crash Flash Checkpoint
+        exists to survive) — but a *live* writer mid-copy may legitimately
+        hold the lock for a long time (multi-GB D2H), so we never steal
+        from a holder whose pid is still alive."""
+        deadline = time.time() + CheckpointConstant.SAVE_TIMEOUT
+        dead_since = None
         while time.time() < deadline:
             if lock.acquire(blocking=False):
                 return True
+            owner = lock.owner()
+            if owner is not None and _pid_alive(owner):
+                dead_since = None  # live writer: wait, never steal
+            elif dead_since is None:
+                dead_since = time.time()
+            elif time.time() - dead_since >= dead_grace:
+                logger.warning(
+                    "shm lock holder (pid %s) is gone; taking the lock over",
+                    owner,
+                )
+                lock.release(force=True)
+                if lock.acquire(blocking=False):
+                    return True
+                dead_since = None  # lost the race; re-observe
             time.sleep(0.2)
-        logger.warning(
-            "shm lock still held after %.0fs; assuming the holder died "
-            "and taking it over", timeout,
+        logger.error(
+            "could not acquire shm lock within %.0fs (holder alive)",
+            CheckpointConstant.SAVE_TIMEOUT,
         )
-        lock.release(force=True)
-        return lock.acquire(blocking=False)
+        return False
 
     def _save_shard(self, step_dir, meta, data, local_rank):
         shard_id = self.host_rank * self.local_shard_num + local_rank

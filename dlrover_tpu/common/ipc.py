@@ -161,6 +161,10 @@ class SharedLock(LocalSocketComm):
         assert self._lock is not None
         return self._lock.locked()
 
+    def _srv_owner(self) -> str | None:
+        assert self._lock is not None
+        return self._owner_id if self._lock.locked() else None
+
     # client API -----------------------------------------------------------
     def acquire(self, blocking: bool = True) -> bool:
         return self._request(
@@ -174,6 +178,10 @@ class SharedLock(LocalSocketComm):
 
     def locked(self) -> bool:
         return self._request("locked")
+
+    def owner(self) -> str | None:
+        """Pid (as str) of the current holder, or None if unheld."""
+        return self._request("owner")
 
 
 class SharedQueue(LocalSocketComm):

@@ -98,7 +98,7 @@ def llama_init(config: LlamaConfig, rng) -> dict:
     """Initialise params (fp32 masters); layer params stacked on axis 0."""
     d, h, hd = config.dim, config.n_heads, config.head_dim
     kvh, m, L = config.n_kv_heads, config.mlp_dim, config.n_layers
-    keys = jax.random.split(rng, 8)
+    keys = jax.random.split(rng, 9)
 
     def norm_init(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32)
@@ -118,7 +118,7 @@ def llama_init(config: LlamaConfig, rng) -> dict:
             "w_down": norm_init(keys[7], (L, m, d), m),
         },
         "final_norm": jnp.ones((d,)),
-        "lm_head": jax.random.normal(keys[0], (d, config.vocab_size)) * 0.02,
+        "lm_head": jax.random.normal(keys[8], (d, config.vocab_size)) * 0.02,
     }
 
 
@@ -208,6 +208,15 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt):
     )(qt, kt, vt)
 
 
+def _seq_axis_active() -> bool:
+    from dlrover_tpu.parallel.mesh import get_mesh
+
+    try:
+        return get_mesh().shape.get("seq", 1) > 1
+    except RuntimeError:
+        return False
+
+
 def _attention(config: LlamaConfig, q, k, v):
     """q: [B,S,H,Dh], k/v: [B,S,KVH,Dh] -> [B,S,H,Dh]."""
     qt = q.transpose(0, 2, 1, 3)
@@ -216,7 +225,13 @@ def _attention(config: LlamaConfig, q, k, v):
     qt = shard_logical(qt, ("batch", "heads", "seq", "head_dim"))
     kt = shard_logical(kt, ("batch", "kv_heads", "seq", "head_dim"))
     vt = shard_logical(vt, ("batch", "kv_heads", "seq", "head_dim"))
-    if config.attn_impl == "flash":
+    if _seq_axis_active():
+        # sequence sharded on the mesh: ring (default) or Ulysses schedule
+        from dlrover_tpu.parallel.sequence import sequence_sharded_attention
+
+        impl = "ulysses" if config.attn_impl == "ulysses" else "ring"
+        out = sequence_sharded_attention(qt, kt, vt, impl=impl, causal=True)
+    elif config.attn_impl == "flash":
         out = _sharded_flash(config, qt, kt, vt)
     else:
         out = mha_reference(qt, kt, vt, causal=True)

@@ -34,3 +34,8 @@ from dlrover_tpu.parallel.accelerate import (  # noqa: F401
     AccelerateResult,
     auto_accelerate,
 )
+from dlrover_tpu.parallel.sequence import (  # noqa: F401
+    ring_attention,
+    sequence_sharded_attention,
+    ulysses_attention,
+)

@@ -234,10 +234,15 @@ def auto_accelerate(
                     )
                 mb = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
                 # keep microbatches sharded like the batch (avoids an SPMD
-                # full-remat on the reshape)
-                return shard_logical(
-                    mb, (None,) + tuple(batch_logical_axes), rules
-                )
+                # full-remat on the reshape); rank-aware like
+                # _shard_batch_leaf for lower-rank leaves
+                if x.ndim >= len(batch_logical_axes):
+                    axes = tuple(batch_logical_axes) + (None,) * (
+                        x.ndim - len(batch_logical_axes)
+                    )
+                else:
+                    axes = (batch_logical_axes[0],) + (None,) * (x.ndim - 1)
+                return shard_logical(mb, (None,) + axes, rules)
 
             micro = jax.tree.map(split, batch)
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)

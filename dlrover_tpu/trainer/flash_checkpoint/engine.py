@@ -595,6 +595,16 @@ class ReplicatedCheckpointEngine(CheckpointEngine):
             return self._all_hosts_ready(step)
         return super().save_to_memory(step, state_dict)
 
+    def save_to_memory_async(
+        self, step: int, state_dict, storage_path: str | None = None
+    ) -> bool:
+        if self._host_rank != 0:
+            # no shards to write here: joining the barrier is the whole
+            # job — inheriting the async path would persist empty shards
+            # whose .done markers corrupt host 0's commit count
+            return self._all_hosts_ready(step)
+        return super().save_to_memory_async(step, state_dict, storage_path)
+
 
 class ShardedCheckpointEngine(CheckpointEngine):
     """GSPMD states: each host writes its unique addressable shards
