@@ -47,18 +47,41 @@ class LlamaConfig:
     remat: bool = True               # checkpoint each scanned layer
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # MoE (mixtral-style FFN swap): 0/1 experts = dense
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_z_weight: float = 1e-3
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 1
+
+    def moe_config(self):
+        from dlrover_tpu.parallel.moe import MoEConfig
+
+        return MoEConfig(
+            n_experts=self.n_experts,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+        )
+
     def param_count(self) -> int:
         d, v, h = self.dim, self.vocab_size, self.head_dim
+        if self.is_moe:
+            ffn = d * self.n_experts + 3 * d * self.mlp_dim * self.n_experts
+        else:
+            ffn = 3 * d * self.mlp_dim      # gate, up, down
         per_layer = (
             d * self.n_heads * h            # wq
             + 2 * d * self.n_kv_heads * h   # wk, wv
             + self.n_heads * h * d          # wo
-            + 3 * d * self.mlp_dim          # gate, up, down
+            + ffn
             + 2 * d                         # norms
         )
         return v * d * 2 + d + self.n_layers * per_layer
@@ -98,12 +121,26 @@ def llama_init(config: LlamaConfig, rng) -> dict:
     """Initialise params (fp32 masters); layer params stacked on axis 0."""
     d, h, hd = config.dim, config.n_heads, config.head_dim
     kvh, m, L = config.n_kv_heads, config.mlp_dim, config.n_layers
-    keys = jax.random.split(rng, 9)
+    keys = jax.random.split(rng, 10)
 
     def norm_init(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32)
                 * (fan_in ** -0.5))
 
+    if config.is_moe:
+        E = config.n_experts
+        ffn_params = {
+            "router": norm_init(keys[9], (L, d, E), d),
+            "w_gate": norm_init(keys[5], (L, E, d, m), d),
+            "w_up": norm_init(keys[6], (L, E, d, m), d),
+            "w_down": norm_init(keys[7], (L, E, m, d), m),
+        }
+    else:
+        ffn_params = {
+            "w_gate": norm_init(keys[5], (L, d, m), d),
+            "w_up": norm_init(keys[6], (L, d, m), d),
+            "w_down": norm_init(keys[7], (L, m, d), m),
+        }
     return {
         "embed": jax.random.normal(keys[0], (config.vocab_size, d)) * 0.02,
         "layers": {
@@ -113,9 +150,7 @@ def llama_init(config: LlamaConfig, rng) -> dict:
             "wv": norm_init(keys[3], (L, d, kvh * hd), d),
             "wo": norm_init(keys[4], (L, h * hd, d), h * hd),
             "mlp_norm": jnp.ones((L, d)),
-            "w_gate": norm_init(keys[5], (L, d, m), d),
-            "w_up": norm_init(keys[6], (L, d, m), d),
-            "w_down": norm_init(keys[7], (L, m, d), m),
+            **ffn_params,
         },
         "final_norm": jnp.ones((d,)),
         "lm_head": jax.random.normal(keys[8], (d, config.vocab_size)) * 0.02,
@@ -124,6 +159,19 @@ def llama_init(config: LlamaConfig, rng) -> dict:
 
 def llama_logical_axes(config: LlamaConfig) -> dict:
     """Logical sharding names matching the ``llama_init`` tree."""
+    if config.is_moe:
+        ffn_axes = {
+            "router": ("layer", "embed", None),
+            "w_gate": ("layer", "expert", "embed", "mlp"),
+            "w_up": ("layer", "expert", "embed", "mlp"),
+            "w_down": ("layer", "expert", "mlp", "embed"),
+        }
+    else:
+        ffn_axes = {
+            "w_gate": ("layer", "embed", "mlp"),
+            "w_up": ("layer", "embed", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+        }
     return {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -133,9 +181,7 @@ def llama_logical_axes(config: LlamaConfig) -> dict:
             "wv": ("layer", "embed", "kv_heads"),
             "wo": ("layer", "heads", "embed"),
             "mlp_norm": ("layer", "embed"),
-            "w_gate": ("layer", "embed", "mlp"),
-            "w_up": ("layer", "embed", "mlp"),
-            "w_down": ("layer", "mlp", "embed"),
+            **ffn_axes,
         },
         "final_norm": ("embed",),
         "lm_head": ("embed", "vocab"),
@@ -256,15 +302,31 @@ def _layer(config: LlamaConfig, x, layer_params, positions):
     x = shard_logical(x, ("batch", "seq", "embed"))
 
     y = _rms_norm(x, p["mlp_norm"], config.norm_eps)
-    gate = jax.nn.silu(y @ p["w_gate"].astype(dtype))
-    up = y @ p["w_up"].astype(dtype)
-    mlp = shard_logical(gate * up, ("batch", "seq", "mlp"))
-    x = x + mlp @ p["w_down"].astype(dtype)
-    return shard_logical(x, ("batch", "seq", "embed"))
+    if config.is_moe:
+        from dlrover_tpu.parallel.moe import moe_ffn
+
+        moe_params = {
+            k: p[k] for k in ("router", "w_gate", "w_up", "w_down")
+        }
+        moe_out, metrics = moe_ffn(y, moe_params, config.moe_config())
+        x = x + moe_out
+        aux = (config.moe_aux_weight * metrics["aux_loss"]
+               + config.moe_z_weight * metrics["z_loss"])
+    else:
+        gate = jax.nn.silu(y @ p["w_gate"].astype(dtype))
+        up = y @ p["w_up"].astype(dtype)
+        mlp = shard_logical(gate * up, ("batch", "seq", "mlp"))
+        x = x + mlp @ p["w_down"].astype(dtype)
+        aux = jnp.zeros((), jnp.float32)
+    return shard_logical(x, ("batch", "seq", "embed")), aux
 
 
-def llama_apply(config: LlamaConfig, params, tokens, positions=None):
-    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+def llama_apply(config: LlamaConfig, params, tokens, positions=None,
+                return_aux: bool = False):
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32.
+
+    With ``return_aux=True`` also returns the summed auxiliary loss
+    (MoE load-balancing + router z-loss; zero for dense models)."""
     dtype = jnp.dtype(config.dtype)
     B, S = tokens.shape
     if positions is None:
@@ -274,20 +336,26 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None):
     x = shard_logical(x, ("batch", "seq", "embed"))
 
     def body(carry, layer_params):
-        out = _layer(config, carry, layer_params, positions)
-        return out, None
+        h, aux_sum = carry
+        out, aux = _layer(config, h, layer_params, positions)
+        return (out, aux_sum + aux), None
 
     if config.remat:
         body = jax.checkpoint(
             body,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         )
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    (x, aux_total), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
 
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
     logits = x @ params["lm_head"].astype(dtype)
     logits = shard_logical(logits, ("batch", "seq", "vocab"))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def llama_loss_fn(config: LlamaConfig):
@@ -295,9 +363,11 @@ def llama_loss_fn(config: LlamaConfig):
 
     def loss_fn(params, batch, rng):
         tokens = batch["tokens"]
-        logits = llama_apply(config, params, tokens[:, :-1])
+        logits, aux = llama_apply(
+            config, params, tokens[:, :-1], return_aux=True
+        )
         labels = tokens[:, 1:]
         loss, valid = softmax_cross_entropy(logits, labels)
-        return loss.sum() / jnp.maximum(valid.sum(), 1)
+        return loss.sum() / jnp.maximum(valid.sum(), 1) + aux
 
     return loss_fn

@@ -34,6 +34,12 @@ from dlrover_tpu.parallel.accelerate import (  # noqa: F401
     AccelerateResult,
     auto_accelerate,
 )
+from dlrover_tpu.parallel.moe import (  # noqa: F401
+    MoEConfig,
+    moe_ffn,
+    moe_init,
+    top_k_gating,
+)
 from dlrover_tpu.parallel.sequence import (  # noqa: F401
     ring_attention,
     sequence_sharded_attention,

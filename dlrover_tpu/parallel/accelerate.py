@@ -208,18 +208,19 @@ def auto_accelerate(
         )
         return loss, aux, grads
 
+    def _batch_axes_for(ndim: int):
+        if ndim >= len(batch_logical_axes):
+            return tuple(batch_logical_axes) + (None,) * (
+                ndim - len(batch_logical_axes)
+            )
+        # lower-rank leaf (lengths, weights): shard the batch dim only
+        return (batch_logical_axes[0],) + (None,) * (ndim - 1)
+
     def _shard_batch_leaf(x):
         ndim = getattr(x, "ndim", None)
         if ndim is None:
             return x
-        if ndim >= len(batch_logical_axes):
-            axes = tuple(batch_logical_axes) + (None,) * (
-                ndim - len(batch_logical_axes)
-            )
-        else:
-            # lower-rank leaf (lengths, weights): shard the batch dim only
-            axes = (batch_logical_axes[0],) + (None,) * (ndim - 1)
-        return shard_logical(x, axes, rules)
+        return shard_logical(x, _batch_axes_for(ndim), rules)
 
     def train_step(state: TrainState, batch, rng):
         batch = jax.tree.map(_shard_batch_leaf, batch)
@@ -234,15 +235,10 @@ def auto_accelerate(
                     )
                 mb = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
                 # keep microbatches sharded like the batch (avoids an SPMD
-                # full-remat on the reshape); rank-aware like
-                # _shard_batch_leaf for lower-rank leaves
-                if x.ndim >= len(batch_logical_axes):
-                    axes = tuple(batch_logical_axes) + (None,) * (
-                        x.ndim - len(batch_logical_axes)
-                    )
-                else:
-                    axes = (batch_logical_axes[0],) + (None,) * (x.ndim - 1)
-                return shard_logical(mb, (None,) + axes, rules)
+                # full-remat on the reshape)
+                return shard_logical(
+                    mb, (None,) + _batch_axes_for(x.ndim), rules
+                )
 
             micro = jax.tree.map(split, batch)
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)

@@ -146,12 +146,16 @@ def test_llama_forward_with_seq_axis():
 
 
 def test_sequence_sharded_attention_wrapper():
+    import dlrover_tpu.parallel.mesh as mesh_mod
     from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh, set_mesh
 
     mesh = build_mesh(MeshConfig(data=2, seq=4, tensor=1))
     set_mesh(mesh)
-    q, k, v = make_qkv(b=4, h=4, s=32)
-    ref = mha_reference(q, k, v, causal=True)
-    out = sequence_sharded_attention(q, k, v, mesh=mesh, impl="ring")
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-5, atol=2e-5)
+    try:
+        q, k, v = make_qkv(b=4, h=4, s=32)
+        ref = mha_reference(q, k, v, causal=True)
+        out = sequence_sharded_attention(q, k, v, mesh=mesh, impl="ring")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        mesh_mod._global_mesh = None
