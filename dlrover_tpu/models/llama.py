@@ -47,6 +47,9 @@ class LlamaConfig:
     remat: bool = True               # checkpoint each scanned layer
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # pipeline microbatches when the ``pipe`` mesh axis is active
+    # (0 = default 2 * n_stages)
+    pipe_microbatches: int = 0
     # MoE (mixtral-style FFN swap): 0/1 experts = dense
     n_experts: int = 0
     moe_top_k: int = 2
@@ -335,19 +338,26 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None,
     x = params["embed"].astype(dtype)[tokens]
     x = shard_logical(x, ("batch", "seq", "embed"))
 
-    def body(carry, layer_params):
-        h, aux_sum = carry
-        out, aux = _layer(config, h, layer_params, positions)
-        return (out, aux_sum + aux), None
-
-    if config.remat:
-        body = jax.checkpoint(
-            body,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        )
-    (x, aux_total), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    from dlrover_tpu.parallel.pipeline import (
+        pipe_size,
+        pipeline_apply,
+        stage_layer_scan,
     )
+
+    stage_fn = stage_layer_scan(
+        lambda h, lp, pos: _layer(config, h, lp, pos),
+        remat=config.remat,
+    )
+    if pipe_size() > 1:
+        # layer stack sharded over the ``pipe`` axis: GPipe microbatch
+        # schedule inside the step (parallel/pipeline.py), embed/head
+        # replicated across stages.
+        x, aux_total = pipeline_apply(
+            stage_fn, params["layers"], x, positions,
+            n_microbatches=config.pipe_microbatches,
+        )
+    else:
+        x, aux_total = stage_fn(params["layers"], x, positions)
 
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
     logits = x @ params["lm_head"].astype(dtype)

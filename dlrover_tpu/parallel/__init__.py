@@ -34,6 +34,11 @@ from dlrover_tpu.parallel.accelerate import (  # noqa: F401
     AccelerateResult,
     auto_accelerate,
 )
+from dlrover_tpu.parallel.pipeline import (  # noqa: F401
+    pipe_size,
+    pipeline_apply,
+    stage_layer_scan,
+)
 from dlrover_tpu.parallel.moe import (  # noqa: F401
     MoEConfig,
     moe_ffn,

@@ -128,6 +128,14 @@ def auto_accelerate(
     mesh = build_mesh(strategy.mesh, devices=devices)
     set_mesh(mesh)
     rules = strategy.rules
+    if mesh.shape.get("pipe", 1) > 1:
+        # pipelining shards the stacked layer axis across stages
+        rules = tuple(
+            ("layer", "pipe") if name == "layer" else (name, ax)
+            for name, ax in rules
+        )
+        if not any(name == "layer" for name, _ in rules):
+            rules = rules + (("layer", "pipe"),)
 
     def spec_of(axes):
         return logical_to_mesh_axes(axes, rules)

@@ -94,6 +94,29 @@ def shard_logical(x, logical_axes, rules: Optional[LogicalRules] = None):
     if mesh.empty:
         return x
     spec = logical_to_mesh_axes(logical_axes, rules)
+
+    # Inside a partial-manual shard_map (e.g. the pipeline schedule) the
+    # constraint must target the current *abstract* mesh, with manual
+    # axes stripped from the spec (they are per-device there).
+    from jax.sharding import PartitionSpec, get_abstract_mesh
+
+    amesh = get_abstract_mesh()
+    if not amesh.empty and amesh.manual_axes:
+        manual = set(amesh.manual_axes)
+
+        def strip(entry):
+            if entry is None:
+                return None
+            flat = (entry,) if isinstance(entry, str) else tuple(entry)
+            kept = tuple(a for a in flat if a not in manual)
+            if not kept:
+                return None
+            return kept[0] if len(kept) == 1 else kept
+
+        spec = PartitionSpec(*(strip(e) for e in spec))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(amesh, spec)
+        )
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
