@@ -1,0 +1,13 @@
+from dlrover_tpu.trainer.elastic.sampler import ElasticSampler
+from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
+from dlrover_tpu.trainer.elastic.dataset import ElasticDataset
+from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer
+from dlrover_tpu.trainer.elastic.prefetch import DevicePrefetcher
+
+__all__ = [
+    "ElasticSampler",
+    "ElasticDataLoader",
+    "ElasticDataset",
+    "ElasticTrainer",
+    "DevicePrefetcher",
+]

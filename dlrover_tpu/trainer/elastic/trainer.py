@@ -1,0 +1,98 @@
+"""ElasticTrainer: fixed global batch under a changing world size.
+
+Equivalent capability: reference dlrover/trainer/torch/elastic/trainer.py —
+when the number of workers changes across a restart, the reference adjusts
+gradient-accumulation steps so ``micro_batch × accum × world == global_batch``
+stays constant (its ``_ElasticOptimizer`` :89 steps only at accumulation
+boundaries).
+
+TPU-first design: instead of wrapping an optimizer object, we wrap the jitted
+train step. :meth:`wrap_step` returns a function that reshapes the per-device
+batch into ``accum`` microbatches and folds them with ``lax.scan``, summing
+gradients on-device — a single XLA program, no Python-level accumulation
+state, and the scan body reuses one compiled microstep (MXU-friendly static
+shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class ElasticTrainer:
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 world_size: int = 1):
+        self.global_batch_size = int(global_batch_size)
+        self.micro_batch_size = int(micro_batch_size)
+        self.set_world_size(world_size)
+
+    def set_world_size(self, world_size: int):
+        """Recompute accumulation for a new world size (post-restart)."""
+        self.world_size = max(1, int(world_size))
+        denom = self.micro_batch_size * self.world_size
+        if self.global_batch_size % denom != 0:
+            logger.warning(
+                "global batch %d not divisible by micro %d x world %d; "
+                "rounding accumulation up",
+                self.global_batch_size, self.micro_batch_size,
+                self.world_size,
+            )
+        self.accum_steps = max(1, -(-self.global_batch_size // denom))
+
+    @property
+    def local_batch_size(self) -> int:
+        """Per-process batch the dataloader should produce each step."""
+        return self.micro_batch_size * self.accum_steps
+
+    # ------------------------------------------------------------- stepping
+
+    def wrap_step(self, grad_fn, apply_fn):
+        """Build an accumulating train step.
+
+        ``grad_fn(params, microbatch) -> (loss, grads)`` — typically
+        ``jax.value_and_grad`` of the loss.
+        ``apply_fn(params, opt_state, grads) -> (params, opt_state)`` — the
+        optimizer update.
+
+        Returns ``step(params, opt_state, batch) -> (params, opt_state,
+        loss)`` where ``batch`` leaves have leading dim ``accum *
+        micro_batch_size``. With ``accum == 1`` the scan collapses to one
+        microstep and XLA elides the loop entirely.
+
+        ``accum_steps`` is read at trace time, so after
+        :meth:`set_world_size` the new accumulation takes effect on the next
+        (re)trace — the changed batch leading dim forces jit to retrace, so
+        a jitted wrapped step stays consistent automatically.
+        """
+
+        def step(params, opt_state, batch):
+            accum = self.accum_steps
+            micro = self.micro_batch_size
+
+            def split(x):
+                return x.reshape((accum, micro) + x.shape[1:])
+
+            micro_batches = jax.tree_util.tree_map(split, batch)
+
+            def body(carry, mb):
+                grads_acc, loss_acc = carry
+                loss, grads = grad_fn(params, mb)
+                grads_acc = jax.tree_util.tree_map(
+                    jnp.add, grads_acc, grads
+                )
+                return (grads_acc, loss_acc + loss), None
+
+            zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (grads, loss_sum), _ = jax.lax.scan(
+                body, (zero_grads, jnp.zeros(())), micro_batches
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+            params, opt_state = apply_fn(params, opt_state, grads)
+            return params, opt_state, loss_sum / accum
+
+        return step

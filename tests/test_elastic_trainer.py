@@ -1,0 +1,231 @@
+"""Tests for the elastic trainer utilities (sampler/dataloader/trainer/
+prefetch) — mirrors reference test coverage for
+dlrover/trainer/torch/elastic/ (sampler mid-epoch resume across world
+sizes, dataloader hot batch-size update, fixed-global-batch accumulation).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.trainer.elastic import (
+    DevicePrefetcher,
+    ElasticDataLoader,
+    ElasticSampler,
+    ElasticTrainer,
+)
+
+
+class RangeDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.array([i], dtype=np.float32)
+
+
+class TestElasticSampler:
+    def test_partition_covers_all(self):
+        world = 4
+        seen = []
+        for rank in range(world):
+            s = ElasticSampler(100, num_replicas=world, rank=rank,
+                               shuffle=True, seed=7)
+            seen.extend(list(s))
+        assert sorted(seen) == list(range(100))
+
+    def test_deterministic_per_epoch(self):
+        a = ElasticSampler(50, 2, 0, seed=3)
+        b = ElasticSampler(50, 2, 0, seed=3)
+        assert list(a) == list(b)
+        a.set_epoch(1)
+        b.set_epoch(0)
+        assert list(a) != list(b)
+
+    def test_mid_epoch_resume_same_world(self):
+        s = ElasticSampler(40, 2, 0, shuffle=True, seed=1)
+        full = list(s)
+        s.record_batch(20)  # 20 global samples consumed -> 10 per rank
+        resumed = list(s)
+        assert resumed == full[10:]
+
+    def test_mid_epoch_resume_world_change(self):
+        # consume 24 global samples at world=2, restore at world=3
+        s = ElasticSampler(48, 2, 0, shuffle=True, seed=5)
+        s.record_batch(24)
+        state = s.state_dict()
+
+        perm = np.random.default_rng(5 + 0).permutation(48)
+        remaining_global = set(perm[24:].tolist())
+        got = []
+        for rank in range(3):
+            s2 = ElasticSampler(48, 3, rank, shuffle=True, seed=5)
+            s2.load_state_dict(state)
+            got.extend(list(s2))
+        assert set(got) == remaining_global
+        assert len(got) == 24
+
+    def test_epoch_exhaustion(self):
+        s = ElasticSampler(10, 1, 0)
+        s.record_batch(100)
+        assert list(s) == []
+        s.set_epoch(1)
+        assert len(list(s)) == 10
+
+
+class TestElasticDataLoader:
+    def test_batches(self):
+        ds = RangeDataset(16)
+        dl = ElasticDataLoader(ds, batch_size=4, config_file="")
+        batches = list(dl)
+        assert len(batches) == 4
+        assert batches[0].shape == (4, 1)
+
+    def test_hot_batch_size_update(self, tmp_path):
+        cfg = tmp_path / "paral.json"
+        cfg.write_text(json.dumps(
+            {"dataloader": {"batch_size": 8, "version": 1}}
+        ))
+        ds = RangeDataset(32)
+        dl = ElasticDataLoader(ds, batch_size=4, config_file=str(cfg))
+        batches = list(dl)
+        assert all(b.shape[0] == 8 for b in batches)
+        assert len(batches) == 4
+
+    def test_stale_version_ignored(self, tmp_path):
+        cfg = tmp_path / "paral.json"
+        cfg.write_text(json.dumps(
+            {"dataloader": {"batch_size": 8, "version": 1}}
+        ))
+        ds = RangeDataset(32)
+        dl = ElasticDataLoader(ds, batch_size=4, config_file=str(cfg))
+        list(dl)
+        # older version must not downgrade
+        cfg.write_text(json.dumps(
+            {"dataloader": {"batch_size": 2, "version": 0}}
+        ))
+        dl.sampler.set_epoch(1)
+        assert next(iter(dl)).shape[0] == 8
+
+    def test_auto_mid_epoch_checkpoint(self):
+        # the loader records global consumption itself: after 3 of 8
+        # batches, a state roundtrip resumes at batch 3, no replay
+        ds = RangeDataset(32)
+        dl = ElasticDataLoader(ds, batch_size=4, config_file="")
+        it = iter(dl)
+        seen = [next(it) for _ in range(3)]
+        state = dl.state_dict()
+        dl2 = ElasticDataLoader(ds, batch_size=4, config_file="")
+        dl2.load_state_dict(state)
+        rest = list(dl2)
+        assert len(rest) == 5
+        all_vals = np.concatenate(
+            [b.ravel() for b in seen + rest]
+        )
+        assert sorted(all_vals.tolist()) == [float(i) for i in range(32)]
+
+    def test_state_roundtrip(self):
+        ds = RangeDataset(32)
+        dl = ElasticDataLoader(ds, batch_size=4, config_file="")
+        dl.sampler.record_batch(8)
+        state = dl.state_dict()
+        dl2 = ElasticDataLoader(ds, batch_size=2, config_file="")
+        dl2.load_state_dict(state)
+        assert dl2.batch_size == 4
+        assert dl2.sampler.completed_num == 8
+
+
+class TestElasticTrainer:
+    def test_accum_math(self):
+        t = ElasticTrainer(global_batch_size=64, micro_batch_size=4,
+                           world_size=4)
+        assert t.accum_steps == 4
+        assert t.local_batch_size == 16
+        t.set_world_size(8)
+        assert t.accum_steps == 2
+        t.set_world_size(16)
+        assert t.accum_steps == 1
+
+    def test_accum_matches_full_batch(self):
+        # gradient of mean-squared loss over an accumulated batch must match
+        # the single-shot full-batch gradient
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (16, 8))
+        y = jax.random.normal(jax.random.PRNGKey(1), (16, 1))
+        w = jnp.zeros((8, 1))
+
+        def loss_fn(params, batch):
+            bx, by = batch
+            pred = bx @ params
+            return jnp.mean((pred - by) ** 2)
+
+        grad_fn = jax.value_and_grad(loss_fn)
+
+        def apply_fn(params, opt_state, grads):
+            return params - 0.1 * grads, opt_state
+
+        t = ElasticTrainer(global_batch_size=16, micro_batch_size=4,
+                           world_size=1)
+        assert t.accum_steps == 4
+        step = jax.jit(t.wrap_step(grad_fn, apply_fn))
+        new_w, _, loss = step(w, None, (x, y))
+
+        full_loss, full_grad = grad_fn(w, (x, y))
+        expected = w - 0.1 * full_grad
+        np.testing.assert_allclose(np.asarray(new_w), np.asarray(expected),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(loss), float(full_loss), rtol=1e-5)
+
+
+class TestElasticDataset:
+    def test_master_served_epoch(self, local_master):
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.trainer.elastic import ElasticDataset
+
+        client = MasterClient(local_master.addr, 0, NodeType.WORKER)
+        MasterClient.reset_singleton(client)
+        try:
+            class ToyDS(ElasticDataset):
+                def read_sample(self, index):
+                    return np.float32(index)
+
+            ds = ToyDS("elastic-ds-test", dataset_size=32, batch_size=4,
+                       epochs=1)
+            dl = ElasticDataLoader(
+                ds, batch_size=4, config_file="",
+                sampler=ElasticSampler(32, shuffle=False),
+            )
+            batches = list(dl)
+            assert len(batches) == 8
+            ds.report_batch_done()
+            vals = sorted(
+                float(v) for b in batches for v in b.ravel()
+            )
+            assert vals == [float(i) for i in range(32)]
+        finally:
+            MasterClient.reset_singleton(None)
+
+
+class TestPrefetcher:
+    def test_yields_all_batches_on_device(self):
+        ds = [np.ones((2, 2)) * i for i in range(5)]
+        out = list(DevicePrefetcher(iter(ds), depth=2))
+        assert len(out) == 5
+        assert isinstance(out[0], jax.Array)
+        np.testing.assert_array_equal(np.asarray(out[3]), ds[3])
+
+    def test_sharded_placement(self):
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("data",))
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("data")
+        )
+        ds = [np.ones((8, 4), dtype=np.float32)] * 3
+        out = list(DevicePrefetcher(iter(ds), sharding=sharding))
+        assert len(out) == 3
+        assert out[0].sharding == sharding
