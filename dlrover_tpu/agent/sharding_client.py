@@ -86,6 +86,13 @@ class ShardingClient:
         for t in tasks:
             self._client.report_task_result(self.dataset_name, t.task_id)
 
+    def report_all_pending_done(self):
+        """Ack every pending shard task (end-of-epoch drain)."""
+        with self._lock:
+            tasks, self._pending_tasks = self._pending_tasks, []
+        for t in tasks:
+            self._client.report_task_result(self.dataset_name, t.task_id)
+
     def report_task_failed(self, task_id: int, err: str):
         self._client.report_task_result(self.dataset_name, task_id, err)
 

@@ -26,6 +26,7 @@ import time
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.monitor import HeartbeatReporter, ResourceMonitor
+from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
 from dlrover_tpu.common.constants import (
     ConfigPath,
     ExitCode,
@@ -189,6 +190,8 @@ class ElasticTrainingAgent:
         )
         self._heartbeat = HeartbeatReporter(client)
         self._resource_monitor = ResourceMonitor(client)
+        self._paral_tuner = ParalConfigTuner(client) \
+            if config.auto_tunning else None
         self._log_files: list[str] = []
         self._ckpt_saver = None
 
@@ -361,6 +364,8 @@ class ElasticTrainingAgent:
             pass  # not the main thread (tests)
         self._heartbeat.start()
         self._resource_monitor.start()
+        if self._paral_tuner is not None:
+            self._paral_tuner.start()
         try:
             self._initialize_workers()
             return self._invoke_run()
@@ -368,6 +373,8 @@ class ElasticTrainingAgent:
             self._stop_workers()
             self._heartbeat.stop()
             self._resource_monitor.stop()
+            if self._paral_tuner is not None:
+                self._paral_tuner.stop()
 
     def _job_name(self) -> str:
         return os.environ.get(NodeEnv.JOB_NAME) or "job_" + (

@@ -100,6 +100,18 @@ class JobManager:
                 self._speed_monitor.add_running_worker(node_type, node_id)
         return ""
 
+    def update_node_paral_config(self, node_type, node_id, paral_config):
+        """Set the ParallelConfig served to a node (auto-tuning output)."""
+        node = self.get_node(node_type, node_id)
+        if node is None:
+            node = self._add_node(node_type, node_id)
+        node.paral_config = paral_config
+
+    def update_all_paral_configs(self, paral_config):
+        for nodes in self.get_job_nodes().values():
+            for node in nodes.values():
+                node.paral_config = paral_config
+
     def update_node_resource_usage(
         self, node_type, node_id, cpu, memory, tpu_stats=None
     ):
@@ -329,6 +341,49 @@ class DistributedJobManager(JobManager):
         self.handle_node_failure(
             node_type, node_id, error_data, level, restart_count
         )
+
+    # -- scaling API (used by JobAutoScaler) -------------------------------
+
+    def create_new_workers(self, count: int, resource=None) -> list[Node]:
+        """Add ``count`` fresh worker nodes (scale-out)."""
+        new_nodes = []
+        with self._lock:
+            for _ in range(count):
+                new_id = self._next_node_id.get(NodeType.WORKER, 0)
+                self._next_node_id[NodeType.WORKER] = new_id + 1
+                node = Node(
+                    NodeType.WORKER,
+                    new_id,
+                    config_resource=resource,
+                    max_relaunch_count=self._relaunch_on_worker_failure,
+                )
+                self._job_nodes.setdefault(NodeType.WORKER, {})[
+                    new_id
+                ] = node
+                new_nodes.append(node)
+        if new_nodes:
+            logger.info(
+                "scale-out: created worker node(s) %s",
+                [n.id for n in new_nodes],
+            )
+        return new_nodes
+
+    def release_node(self, node_type: str, node_id: int):
+        """Mark a node released (scale-in); the scaler deletes its pod."""
+        node = self.get_node(node_type, node_id)
+        if node is None or node.is_released:
+            return
+        node.relaunchable = False
+        node.is_released = True
+        node.update_status(NodeStatus.DELETED)
+        if self._speed_monitor is not None:
+            self._speed_monitor.remove_running_worker(node_type, node_id)
+        # same exit path as a watcher DELETED event: drop from rendezvous,
+        # requeue its in-flight shards
+        self._run_node_exit_callbacks(node)
+        if self._scaler is not None and hasattr(self._scaler, "remove_node"):
+            self._scaler.remove_node(node)
+        logger.info("scale-in: released node %s-%s", node_type, node_id)
 
     def stop(self):
         super().stop()

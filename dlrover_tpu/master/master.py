@@ -159,6 +159,18 @@ class DistributedJobMaster(JobMaster):
         # in-flight shards back (code-review finding: these existed but
         # were never wired).
         self.job_manager.add_node_exit_callback(self._on_node_exit)
+        # Periodic worker-count healing (reference job_auto_scaler.py:254);
+        # quantized to node_unit so partial TPU slices are never requested.
+        from dlrover_tpu.master.auto_scaler import (
+            AllreduceTrainingAutoScaler,
+        )
+
+        self.auto_scaler = AllreduceTrainingAutoScaler(
+            self.job_manager,
+            scaler=scaler,
+            target_worker_num=getattr(job_args, "node_num", 0) or 0,
+            node_unit=getattr(job_args, "node_unit", 1) or 1,
+        )
         self._exit_code = 0
         self._exit_reason = ""
 
@@ -184,6 +196,8 @@ class DistributedJobMaster(JobMaster):
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
+        if getattr(self._job_args, "auto_scaling", True):
+            self.auto_scaler.start_auto_scaling()
         logger.info(
             "DistributedJobMaster serving on port %s for job %s",
             self.port,
@@ -231,6 +245,7 @@ class DistributedJobMaster(JobMaster):
         return self._exit_code
 
     def stop(self):
+        self.auto_scaler.stop_auto_scaling()
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop()

@@ -150,6 +150,19 @@ class PodScaler:
         with self._queue_lock:
             self._create_queue.append(new_node)
 
+    def remove_node(self, node: Node):
+        """Scale-in: delete the node's pod (and drop any queued creation)."""
+        with self._queue_lock:
+            self._create_queue = [
+                n for n in self._create_queue
+                if not (n.type == node.type and n.id == node.id)
+            ]
+        name = node.name or f"{self._job_name}-{node.type}-{node.id}"
+        try:
+            self._client.delete_pod(name)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("delete pod %s failed: %s", name, e)
+
     def _periodic_create_pods(self):
         while not self._stopped.is_set():
             node = None

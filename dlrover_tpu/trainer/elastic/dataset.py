@@ -56,8 +56,14 @@ class ElasticDataset(ABC):
         return self.read_sample(index)
 
     def report_batch_done(self, task_ids=None):
-        """Ack consumed shard tasks to the master (all pending if None)."""
+        """Ack the oldest pending shard task (call once per consumed
+        batch), or the specific ``task_ids``."""
         self._client.report_batch_done(task_ids)
+
+    def report_all_shards_done(self):
+        """Ack every pending shard (end-of-epoch drain, so the master's
+        task accounting reaches 'finished')."""
+        self._client.report_all_pending_done()
 
     def get_shard_checkpoint(self) -> str:
         return self._client.get_shard_checkpoint()

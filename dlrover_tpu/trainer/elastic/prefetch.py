@@ -21,11 +21,10 @@ class DevicePrefetcher:
     placement; None leaves arrays on the default device.
     """
 
-    def __init__(self, iterator, sharding=None, depth: int = 2):
-        self._it = iter(iterator)
+    def __init__(self, iterable, sharding=None, depth: int = 2):
+        self._iterable = iterable
         self._sharding = sharding
         self._depth = max(1, int(depth))
-        self._queue: collections.deque = collections.deque()
 
     def _put(self, batch):
         if self._sharding is not None:
@@ -35,15 +34,19 @@ class DevicePrefetcher:
         return jax.tree_util.tree_map(jax.device_put, batch)
 
     def __iter__(self):
+        # fresh iterator per epoch so the wrapper is re-iterable (and the
+        # underlying loader's per-epoch hot-reconfig re-runs)
+        it = iter(self._iterable)
+        queue: collections.deque = collections.deque()
         try:
-            while len(self._queue) < self._depth:
-                self._queue.append(self._put(next(self._it)))
+            while len(queue) < self._depth:
+                queue.append(self._put(next(it)))
         except StopIteration:
             pass
-        while self._queue:
-            out = self._queue.popleft()
+        while queue:
+            out = queue.popleft()
             try:
-                self._queue.append(self._put(next(self._it)))
+                queue.append(self._put(next(it)))
             except StopIteration:
                 pass
             yield out
