@@ -66,7 +66,9 @@ class ParalConfigTuner:
         payload = json.dumps(dataclasses.asdict(config), sort_keys=True)
         if payload == self._last_written:
             return False
-        os.makedirs(os.path.dirname(self._config_path), exist_ok=True)
+        config_dir = os.path.dirname(self._config_path)
+        if config_dir:
+            os.makedirs(config_dir, exist_ok=True)
         tmp = self._config_path + ".tmp"
         with open(tmp, "w") as f:
             f.write(payload)

@@ -70,7 +70,24 @@ class JobAutoScaler(ABC):
         """Hook: subclasses may adopt an executed count as the new target."""
 
     def execute_job_optimization_plan(self, plan: ResourcePlan):
-        """Apply group-count changes by adding/releasing worker nodes."""
+        """Apply group-count changes and per-node resource overrides."""
+        # Per-node overrides (OOM memory bumps): mutate config_resource in
+        # place — a relaunched replacement aliases its parent's
+        # config_resource (Node.get_relaunch_node_info), so the bump
+        # reaches the next pod spec.
+        for name, res in plan.node_resources.items():
+            node = self._job_manager.get_node_by_name(name)
+            if node is None:
+                continue
+            if res.memory:
+                node.config_resource.memory = res.memory
+            if res.cpu:
+                node.config_resource.cpu = res.cpu
+            logger.info(
+                "applied resource override to %s: cpu=%s mem=%sMi",
+                name, node.config_resource.cpu,
+                node.config_resource.memory,
+            )
         group = plan.node_group_resources.get(NodeType.WORKER)
         if group is None:
             return
@@ -113,6 +130,9 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
         super().__init__(job_manager, scaler, interval)
         self._target_worker_num = int(target_worker_num)
         self._node_unit = max(1, int(node_unit))
+        # permanent failures already subtracted from the target (each node
+        # shrinks it exactly once — no ratcheting)
+        self._permanent_seen: set = set()
 
     def on_group_count_applied(self, count: int):
         # an executed plan (including an external / PS-optimizer one)
@@ -133,16 +153,22 @@ class AllreduceTrainingAutoScaler(JobAutoScaler):
         )
         # Nodes whose failure was unrecoverable (FATAL_ERROR / relaunches
         # exhausted) must NOT be resurrected as fresh nodes — that would be
-        # an unbounded crash loop. They permanently shrink the achievable
-        # world.
-        permanent = sum(
-            1 for n in nodes.values()
-            if n.status == NodeStatus.FAILED
-            and not self._job_manager._should_relaunch(n)
-        )
-        achievable = self._target_worker_num - permanent
+        # an unbounded crash loop. Each newly-seen one permanently shrinks
+        # the target by exactly one.
+        for node_id, n in nodes.items():
+            if node_id in self._permanent_seen:
+                continue
+            if self._job_manager.is_permanently_failed(n):
+                self._permanent_seen.add(node_id)
+                self._target_worker_num -= 1
+                logger.warning(
+                    "worker %s failed permanently; target now %d",
+                    node_id, self._target_worker_num,
+                )
         # never request a partial TPU slice: round DOWN to whole node_units
-        achievable = (achievable // self._node_unit) * self._node_unit
+        achievable = (
+            self._target_worker_num // self._node_unit
+        ) * self._node_unit
         if achievable <= 0 or alive == achievable:
             return None
         plan = ResourcePlan()
@@ -161,7 +187,8 @@ class PSTrainingAutoScaler(JobAutoScaler):
                  scaler=None, interval: float = 30.0):
         super().__init__(job_manager, scaler, interval)
         self._resource_optimizer = resource_optimizer
-        self._last_oom_check = 0.0
+        # OOM events already turned into a memory bump (one bump per event)
+        self._oom_handled: set = set()
 
     def plan(self) -> ResourcePlan | None:
         plan = self._resource_optimizer.get_plan()
@@ -174,7 +201,9 @@ class PSTrainingAutoScaler(JobAutoScaler):
         out = []
         for nodes in self._job_manager.get_job_nodes().values():
             for node in nodes.values():
+                key = (node.type, node.id)
                 if node.exit_reason == NodeExitReason.OOM \
-                        and not node.is_released:
+                        and key not in self._oom_handled:
+                    self._oom_handled.add(key)
                     out.append(node)
         return out

@@ -64,6 +64,34 @@ class JobManager:
         with self._lock:
             return self._job_nodes.get(node_type, {}).get(node_id)
 
+    def get_node_by_name(self, name: str) -> Node | None:
+        with self._lock:
+            for nodes in self._job_nodes.values():
+                for node in nodes.values():
+                    if node.name == name:
+                        return node
+        return None
+
+    def is_permanently_failed(self, node: Node) -> bool:
+        """True when a failed node must NOT come back in any form (the
+        public face of the relaunch policy, for the auto-scaler)."""
+        return node.status == NodeStatus.FAILED and \
+            not self._should_relaunch(node)
+
+    def _should_relaunch(self, node: Node) -> bool:
+        """Reference _should_relaunch (dist_job_manager.py:561): relaunch
+        unless the failure is unrecoverable, the node opted out, or the
+        exit was a clean success."""
+        if node.status == NodeStatus.SUCCEEDED:
+            return False
+        if not node.relaunchable:
+            return False
+        if node.exit_reason == NodeExitReason.FATAL_ERROR:
+            return False
+        if node.is_unrecoverable_failure():
+            return False
+        return True
+
     def all_workers_exited(self) -> bool:
         with self._lock:
             workers = list(self._job_nodes.get(NodeType.WORKER, {}).values())
@@ -302,20 +330,6 @@ class DistributedJobManager(JobManager):
                 node.id,
                 node.unrecoverable_failure_msg or node.exit_reason,
             )
-
-    def _should_relaunch(self, node: Node) -> bool:
-        """Reference _should_relaunch (dist_job_manager.py:561): relaunch
-        unless the failure is unrecoverable, the node opted out, or the
-        exit was a clean success."""
-        if node.status == NodeStatus.SUCCEEDED:
-            return False
-        if not node.relaunchable:
-            return False
-        if node.exit_reason == NodeExitReason.FATAL_ERROR:
-            return False
-        if node.is_unrecoverable_failure():
-            return False
-        return True
 
     def _relaunch_node(self, node: Node):
         with self._lock:

@@ -50,3 +50,13 @@ from dlrover_tpu.parallel.sequence import (  # noqa: F401
     sequence_sharded_attention,
     ulysses_attention,
 )
+from dlrover_tpu.parallel.engine import (  # noqa: F401
+    DryRunner,
+    DryRunResult,
+    ModelAnalysis,
+    StrategySearchEngine,
+    analyse_params,
+    candidate_strategies,
+    estimate_hbm_per_device,
+    search_strategy,
+)

@@ -175,6 +175,33 @@ class TestAllreduceAutoScaler:
         assert len(alive) == 2
 
 
+    def test_no_ratchet_on_repeated_plans(self):
+        """A single permanent failure shrinks the target exactly once,
+        even across many plan/execute cycles."""
+        from dlrover_tpu.common.constants import NodeExitReason as ER
+
+        mgr = make_manager(4)
+        nodes = mgr.get_job_nodes(NodeType.WORKER)
+        for n in nodes.values():
+            n.update_status(NodeStatus.RUNNING)
+        nodes[3].update_status(NodeStatus.FAILED)
+        nodes[3].set_exit_reason(ER.FATAL_ERROR)
+        nodes[3].is_released = True
+        scaler = AllreduceTrainingAutoScaler(
+            mgr, target_worker_num=4, node_unit=1
+        )
+        for _ in range(5):
+            plan = scaler.plan()
+            if plan is not None:
+                scaler.execute_job_optimization_plan(plan)
+        assert scaler._target_worker_num == 3
+        alive = [
+            n for n in mgr.get_job_nodes(NodeType.WORKER).values()
+            if not n.is_released
+        ]
+        assert len(alive) == 3
+
+
 class TestPSAutoScaler:
     def test_oom_merge(self):
         mgr = make_manager(2)
@@ -189,6 +216,11 @@ class TestPSAutoScaler:
         scaler = PSTrainingAutoScaler(mgr, jro)
         plan = scaler.plan()
         assert plan.node_resources["worker-0"].memory == 2048
+        # executing the plan bumps the node's config_resource in place
+        scaler.execute_job_optimization_plan(plan)
+        assert nodes[0].config_resource.memory == 2048
+        # each OOM event is handled once: next cycle yields no new bump
+        assert scaler.plan().empty()
 
 
 class TestParalConfigTuner:

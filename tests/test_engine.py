@@ -1,0 +1,184 @@
+"""Tests for the strategy search engine (analyser, candidate generation,
+dry-runner, task loop) — reference coverage analogue:
+atorch/tests auto_accelerate_test.py / engine tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.parallel.engine import (
+    DryRunner,
+    DryRunResult,
+    ModelAnalysis,
+    StrategySearchEngine,
+    TaskType,
+    analyse_params,
+    candidate_strategies,
+    estimate_hbm_per_device,
+    search_strategy,
+    _factorizations,
+)
+from dlrover_tpu.parallel.strategy import Strategy
+
+
+def small_analysis(**kw):
+    d = dict(param_count=1_000_000, param_bytes=4_000_000, n_layers=4)
+    d.update(kw)
+    return ModelAnalysis(**d)
+
+
+class TestFactorizations:
+    def test_products(self):
+        for f in _factorizations(8, 4):
+            assert np.prod(f) == 8
+        assert len(set(_factorizations(8, 4))) == len(
+            list(_factorizations(8, 4))
+        )
+
+
+class TestAnalyse:
+    def test_counts_params(self):
+        params = {
+            "w": jnp.zeros((4, 8)),
+            "layers": jnp.zeros((6, 3, 3)),
+        }
+        a = analyse_params(params)
+        assert a.param_count == 32 + 54
+        assert a.n_layers == 6
+
+    def test_on_eval_shape(self):
+        def init(rng):
+            return {"w": jnp.zeros((10, 10), jnp.float32)}
+
+        abstract = jax.eval_shape(init, jax.random.key(0))
+        a = analyse_params(abstract)
+        assert a.param_count == 100
+        assert a.param_bytes == 400
+
+
+class TestCandidates:
+    def test_prefers_fsdp(self):
+        cands = candidate_strategies(8, small_analysis(), hbm_gb=16.0)
+        assert cands, "no candidates generated"
+        top = cands[0].mesh
+        assert top.fsdp == 8 and top.tensor == 1 and top.pipe == 1
+
+    def test_memory_filter_forces_sharding(self):
+        # 7B params on tiny HBM: pure-DP (fsdp=1,data=8) must be infeasible
+        a = small_analysis(param_count=7_000_000_000)
+        cands = candidate_strategies(8, a, hbm_gb=16.0)
+        for s in cands:
+            m = s.mesh
+            assert m.fsdp * m.tensor * m.pipe > 1
+
+    def test_tensor_capped_at_host(self):
+        cands = candidate_strategies(
+            16, small_analysis(), devices_per_host=4
+        )
+        assert all(s.mesh.tensor <= 4 for s in cands)
+
+    def test_long_context_adds_seq(self):
+        cands = candidate_strategies(
+            8, small_analysis(), seq_len=131072, hbm_gb=1024.0
+        )
+        assert any(s.mesh.seq > 1 for s in cands)
+
+    def test_moe_adds_expert(self):
+        a = small_analysis(moe=True, n_experts=8)
+        cands = candidate_strategies(8, a, hbm_gb=1024.0)
+        assert any(s.mesh.expert > 1 for s in cands)
+
+
+class TestEstimate:
+    def test_sharding_reduces_estimate(self):
+        a = small_analysis(param_count=100_000_000)
+        from dlrover_tpu.parallel.mesh import MeshConfig
+
+        rep = Strategy(mesh=MeshConfig(fsdp=1))
+        shard = Strategy(mesh=MeshConfig(fsdp=8))
+        assert estimate_hbm_per_device(a, shard) < estimate_hbm_per_device(
+            a, rep
+        )
+
+
+class TestTaskLoop:
+    def test_dryrun_then_finish(self):
+        engine = StrategySearchEngine(
+            8, small_analysis(), max_dryruns=2
+        )
+        t1 = engine.get_task()
+        assert t1.task_type == TaskType.DRYRUN
+        engine.report_task_result(
+            t1.task_id, DryRunResult(t1.strategy, step_s=0.5)
+        )
+        t2 = engine.get_task()
+        assert t2.task_type == TaskType.DRYRUN
+        engine.report_task_result(
+            t2.task_id, DryRunResult(t2.strategy, step_s=0.1)
+        )
+        t3 = engine.get_task()
+        assert t3.task_type == TaskType.FINISH
+        assert t3.strategy == t2.strategy  # faster one wins
+
+    def test_failed_results_skipped(self):
+        engine = StrategySearchEngine(8, small_analysis(), max_dryruns=1)
+        t = engine.get_task()
+        engine.report_task_result(
+            t.task_id, DryRunResult(t.strategy, ok=False, error="OOM")
+        )
+        final = engine.get_task()
+        assert final.task_type == TaskType.FINISH
+        assert final.strategy is not None
+
+
+def _tiny_model():
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (16, 32)) * 0.02,
+            "w2": jax.random.normal(k2, (32, 16)) * 0.02,
+        }
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        h = jnp.tanh(x @ params["w1"])
+        pred = h @ params["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    axes = {"w1": ("embed", "mlp"), "w2": ("mlp", "embed")}
+
+    def make_batch():
+        x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        return x, x
+
+    return loss_fn, init_fn, axes, make_batch
+
+
+class TestMeasuredSearch:
+    def test_search_strategy_end_to_end(self):
+        loss_fn, init_fn, axes, make_batch = _tiny_model()
+        best = search_strategy(
+            loss_fn, init_fn, optax.sgd(0.1), axes, make_batch,
+            n_devices=8, max_dryruns=2, max_candidates=2,
+            allow_pipe=False,
+        )
+        assert isinstance(best, Strategy)
+        total = (best.mesh.fsdp * best.mesh.data * best.mesh.tensor
+                 * best.mesh.seq * best.mesh.expert * best.mesh.pipe)
+        assert total == 8
+
+    def test_dry_runner_reports_timing(self):
+        loss_fn, init_fn, axes, make_batch = _tiny_model()
+        from dlrover_tpu.parallel.engine import (
+            make_auto_accelerate_dry_runner,
+        )
+
+        runner = make_auto_accelerate_dry_runner(
+            loss_fn, init_fn, optax.sgd(0.1), axes, make_batch
+        )
+        res = runner.profile(Strategy())
+        assert res.ok, res.error
+        assert res.step_s > 0
+        assert res.compile_s > 0
