@@ -87,6 +87,57 @@ def quantize_int8(x, seed: int = 0, stochastic: bool = True,
     return q, scales, orig_shape
 
 
+# Non-negative tensors with huge dynamic range (Adam's second moment) use
+# a log-spaced codebook instead of linear absmax — the TPU analogue of the
+# reference's *dynamic* 8-bit code: a nonlinear codebook is required
+# because linear absmax zeroes small entries and the Adam denominator
+# then collapses to eps.
+#
+# log-spaced codebook for non-negative values: index 0 is exact zero;
+# indices 1..255 span [LOG_FLOOR, 1] * blockwise absmax geometrically.
+LOG_FLOOR = 1e-12
+_LOG_LEVELS = 255
+
+
+def _log_codebook():
+    import numpy as np
+
+    code = np.geomspace(LOG_FLOOR, 1.0, _LOG_LEVELS)
+    return jnp.asarray(np.concatenate([[0.0], code]), jnp.float32)
+
+
+def quantize_pos_log(x):
+    """Blockwise log-codebook quantization for non-negative tensors.
+
+    Returns (q uint8 [rows, BLOCK], scales f32 [rows, 1]). Relative error
+    is ~|log step| (~11%) for every magnitude down to LOG_FLOOR x absmax;
+    only exact zeros map to zero, so a requantized Adam denominator can
+    never collapse for a live coordinate.
+    """
+    blocks, _n = _pad_to_blocks(x.reshape(-1))
+    absmax = jnp.max(blocks, axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax)
+    rel = blocks / scale
+    # nearest codebook index in log space; zeros stay at index 0
+    log_rel = jnp.log(jnp.maximum(rel, LOG_FLOOR))
+    log_lo = jnp.log(LOG_FLOOR)
+    step = -log_lo / (_LOG_LEVELS - 1)
+    idx = jnp.clip(
+        jnp.round((log_rel - log_lo) / step) + 1, 1, _LOG_LEVELS
+    ).astype(jnp.uint8)
+    q = jnp.where(rel > 0.0, idx, jnp.uint8(0))
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_pos_log(q, scales, orig_shape, dtype=jnp.float32):
+    code = _log_codebook()
+    out = code[q.astype(jnp.int32)] * scales
+    n = 1
+    for d in orig_shape:
+        n *= d
+    return out.reshape(-1)[:n].reshape(orig_shape).astype(dtype)
+
+
 def dequantize_int8(q, scales, orig_shape, dtype=jnp.float32,
                     interpret: bool | None = None):
     if interpret is None:

@@ -1,0 +1,12 @@
+from dlrover_tpu.optimizers.agd import agd, scale_by_agd
+from dlrover_tpu.optimizers.wsam import make_wsam_grad_fn, wsam_update
+from dlrover_tpu.optimizers.low_bit import adam8bit, scale_by_adam8bit
+
+__all__ = [
+    "agd",
+    "scale_by_agd",
+    "make_wsam_grad_fn",
+    "wsam_update",
+    "adam8bit",
+    "scale_by_adam8bit",
+]

@@ -1,0 +1,131 @@
+"""8-bit Adam: optimizer moments stored as block-quantized 8-bit codes.
+
+Equivalent capability: reference atorch/atorch/optimizers/low_bit/ backed
+by the CUDA kernels in atorch/atorch/ops/csrc/quantization/
+(quantization_optimizer.cu — 8-bit Adam state with blockwise scales and
+stochastic rounding). TPU redesign:
+
+- the first moment (signed, moderate dynamic range) uses the Pallas
+  linear-absmax int8 kernel with stochastic rounding (unbiased, so
+  quantization noise doesn't bias the EMA);
+- the second moment (non-negative, huge dynamic range) uses a log-spaced
+  codebook (the analogue of the reference's nonlinear "dynamic" code):
+  linear absmax would round small entries to zero and the Adam
+  denominator would collapse to eps, exploding those coordinates.
+
+Memory for optimizer state drops ~4x vs fp32 Adam — on HBM-bound TPU
+training that directly buys larger batch or model shards.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.ops.quantization import (
+    BLOCK,
+    dequantize_int8,
+    dequantize_pos_log,
+    quantize_int8,
+    quantize_pos_log,
+)
+
+
+class QuantizedMoment(NamedTuple):
+    q: jnp.ndarray       # int8/uint8 [rows, BLOCK]
+    scales: jnp.ndarray  # f32 [rows, 1]
+
+
+def _rows_for(leaf) -> int:
+    n = 1
+    for d in leaf.shape:
+        n *= d
+    return -(-max(n, 1) // BLOCK)
+
+
+def _zero_moment(leaf, dtype) -> QuantizedMoment:
+    rows = _rows_for(leaf)
+    return QuantizedMoment(
+        q=jnp.zeros((rows, BLOCK), dtype),
+        scales=jnp.ones((rows, 1), jnp.float32),
+    )
+
+
+class ScaleByAdam8bitState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates  # pytree of QuantizedMoment (int8 linear)
+    nu: optax.Updates  # pytree of QuantizedMoment (uint8 log-code)
+
+
+def scale_by_adam8bit(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        # zeros quantize trivially: build the int8 state directly instead
+        # of running quantization kernels over zero tensors
+        return ScaleByAdam8bitState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(lambda p: _zero_moment(p, jnp.int8), params),
+            nu=jax.tree.map(lambda p: _zero_moment(p, jnp.uint8), params),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        is_qm = lambda x: isinstance(x, QuantizedMoment)  # noqa: E731
+        mu_f = jax.tree.map(
+            lambda qm, g: dequantize_int8(qm.q, qm.scales, g.shape),
+            state.mu, updates, is_leaf=is_qm,
+        )
+        nu_f = jax.tree.map(
+            lambda qm, g: dequantize_pos_log(qm.q, qm.scales, g.shape),
+            state.nu, updates, is_leaf=is_qm,
+        )
+        mu_f = jax.tree.map(
+            lambda m, g: b1 * m + (1 - b1) * g, mu_f, updates
+        )
+        nu_f = jax.tree.map(
+            lambda v, g: b2 * v + (1 - b2) * g * g, nu_f, updates
+        )
+        mu_hat = jax.tree.map(lambda m: m / (1 - b1 ** count), mu_f)
+        nu_hat = jax.tree.map(lambda v: v / (1 - b2 ** count), nu_f)
+        new_updates = jax.tree.map(
+            lambda m, v: m / (jnp.sqrt(v) + eps), mu_hat, nu_hat
+        )
+        # per-step seed (traced) keeps stochastic rounding unbiased across
+        # steps; quantize_int8 accepts a traced seed under jit.
+        mu_leaves, mu_def = jax.tree.flatten(mu_f)
+        mu_q = jax.tree.unflatten(mu_def, [
+            QuantizedMoment(*quantize_int8(
+                leaf, seed=count * 7919 + i, stochastic=True
+            )[:2])
+            for i, leaf in enumerate(mu_leaves)
+        ])
+        nu_q = jax.tree.map(
+            lambda v: QuantizedMoment(*quantize_pos_log(v)), nu_f
+        )
+        return new_updates, ScaleByAdam8bitState(
+            count=count, mu=mu_q, nu=nu_q
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adam8bit(
+    learning_rate: float | optax.Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """8-bit AdamW (decoupled weight decay on top of quantized moments)."""
+    tx = [scale_by_adam8bit(b1=b1, b2=b2, eps=eps)]
+    if weight_decay:
+        tx.append(optax.add_decayed_weights(weight_decay))
+    tx.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*tx)
