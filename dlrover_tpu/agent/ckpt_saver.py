@@ -94,6 +94,10 @@ class CheckpointMeta:
     num_hosts: int = 1
     total_bytes: int = 0
     user_meta: dict = field(default_factory=dict)
+    # CRC-32 of the persisted payload (set at persist time; -1 = absent).
+    # Verified on read so a torn/corrupted shard file is rejected instead
+    # of silently restoring garbage.
+    payload_crc: int = -1
 
 
 @dataclass
@@ -204,7 +208,13 @@ def host_shard_filename(host_rank: int) -> str:
 
 def write_host_shard(storage, path: str, meta: CheckpointMeta, data) -> None:
     """Stream header + meta + payload; ``data`` may be a memoryview into
-    shm — never copy the (multi-GB) payload into an intermediate blob."""
+    shm — never copy the (multi-GB) payload into an intermediate blob.
+
+    The payload CRC (native libdlrtpu crc32, zlib fallback) is stamped
+    into the meta so restores detect torn or bit-rotted shard files."""
+    from dlrover_tpu import native as dlrtpu_native
+
+    meta.payload_crc = dlrtpu_native.crc32(data)
     meta_bytes = pickle.dumps(meta)
     storage.write_parts(
         [
@@ -223,6 +233,16 @@ def read_host_shard(path: str) -> tuple[CheckpointMeta, bytes] | None:
         meta_len = int.from_bytes(f.read(_META_LEN_SIZE), "little")
         meta = pickle.loads(f.read(meta_len))
         data = f.read(meta.total_bytes)
+    if meta.payload_crc >= 0:
+        from dlrover_tpu import native as dlrtpu_native
+
+        actual = dlrtpu_native.crc32(data)
+        if actual != meta.payload_crc:
+            logger.error(
+                "checksum mismatch reading %s (want %08x got %08x); "
+                "rejecting shard", path, meta.payload_crc, actual,
+            )
+            return None
     return meta, data
 
 

@@ -157,6 +157,78 @@ class TrainingMetricsReporter:
             self._stopped.wait(self._interval)
 
 
+class TimerRingExporter:
+    """Drains the shared timing ring and exports per-tag aggregates —
+    the out-of-process half of the xpu_timer capability (reference
+    atorch/dev/xpu_timer: in-proc hook -> shm -> brpc/Prometheus
+    exporter; here: StepTimer -> shm ring -> JSON file + logs)."""
+
+    def __init__(self, interval=JobConstant.MONITOR_INTERVAL,
+                 out_path: str | None = None):
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._out_path = out_path or os.path.join(
+            os.path.dirname(ConfigPath.RUNTIME_METRICS),
+            "timer_stats.json",
+        )
+        self._timer = None
+        self._totals: dict = {}
+
+    def start(self):
+        threading.Thread(
+            target=self._loop, name="timer-exporter", daemon=True
+        ).start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _ensure_timer(self):
+        if self._timer is None:
+            from dlrover_tpu.trainer.timer import get_step_timer
+
+            self._timer = get_step_timer()
+        return self._timer
+
+    def export_once(self) -> dict:
+        """Drain + aggregate; returns {tag_name: {count, avg_ms, max_ms}}."""
+        from dlrover_tpu.trainer.timer import Tag
+
+        try:
+            records = self._ensure_timer().drain()
+        except Exception:  # noqa: BLE001 - ring not created yet
+            return {}
+        for tag, _start, dur in records:
+            agg = self._totals.setdefault(
+                tag, {"count": 0, "total_ns": 0, "max_ns": 0}
+            )
+            agg["count"] += 1
+            agg["total_ns"] += dur
+            agg["max_ns"] = max(agg["max_ns"], dur)
+        stats = {
+            Tag.NAMES.get(tag, str(tag)): {
+                "count": a["count"],
+                "avg_ms": round(a["total_ns"] / a["count"] / 1e6, 3),
+                "max_ms": round(a["max_ns"] / 1e6, 3),
+            }
+            for tag, a in self._totals.items()
+        }
+        if records:
+            os.makedirs(os.path.dirname(self._out_path), exist_ok=True)
+            tmp = f"{self._out_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(stats, f)
+            os.replace(tmp, self._out_path)
+        return stats
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                self.export_once()
+            except Exception:  # noqa: BLE001
+                pass
+            self._stopped.wait(self._interval)
+
+
 def write_runtime_metrics(step: int, **extra):
     """Called from the training loop (worker side) to publish progress."""
     path = os.environ.get(

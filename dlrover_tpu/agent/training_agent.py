@@ -25,7 +25,11 @@ import sys
 import time
 
 from dlrover_tpu.agent.master_client import MasterClient
-from dlrover_tpu.agent.monitor import HeartbeatReporter, ResourceMonitor
+from dlrover_tpu.agent.monitor import (
+    HeartbeatReporter,
+    ResourceMonitor,
+    TimerRingExporter,
+)
 from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
 from dlrover_tpu.common.constants import (
     ConfigPath,
@@ -192,6 +196,7 @@ class ElasticTrainingAgent:
         self._resource_monitor = ResourceMonitor(client)
         self._paral_tuner = ParalConfigTuner(client) \
             if config.auto_tunning else None
+        self._timer_exporter = TimerRingExporter()
         self._log_files: list[str] = []
         self._ckpt_saver = None
 
@@ -364,6 +369,7 @@ class ElasticTrainingAgent:
             pass  # not the main thread (tests)
         self._heartbeat.start()
         self._resource_monitor.start()
+        self._timer_exporter.start()
         if self._paral_tuner is not None:
             self._paral_tuner.start()
         try:
@@ -373,6 +379,7 @@ class ElasticTrainingAgent:
             self._stop_workers()
             self._heartbeat.stop()
             self._resource_monitor.stop()
+            self._timer_exporter.stop()
             if self._paral_tuner is not None:
                 self._paral_tuner.stop()
 

@@ -322,12 +322,17 @@ def get_or_create_shm(name: str, size: int = 0) -> PersistentSharedMemory:
                 shm.unlink()
             except FileNotFoundError:
                 pass
-            return PersistentSharedMemory(name=name, create=True, size=size)
+            shm = PersistentSharedMemory(name=name, create=True, size=size)
+            shm.just_created = True
+            return shm
+        shm.just_created = False
         return shm
     except FileNotFoundError:
         if size <= 0:
             raise
-        return PersistentSharedMemory(name=name, create=True, size=size)
+        shm = PersistentSharedMemory(name=name, create=True, size=size)
+        shm.just_created = True
+        return shm
 
 
 def wait_for_path(path: str, timeout: float = 60.0, interval=0.1) -> bool:

@@ -1,0 +1,293 @@
+"""ctypes bindings for libdlrtpu (native runtime helpers).
+
+Equivalent capability: the binding layer the reference gets from torch
+C++ extensions / pybind (atorch/atorch/ops/op_builder JIT build + load).
+Here: the library under ``native/`` is compiled on first use with g++
+(no pybind11 in the image; plain ``extern "C"`` + ctypes), cached in
+``native/build/``, and every entry point has a pure-Python fallback so
+the framework works without a toolchain.
+
+Surface:
+- :func:`scatter_copy` — multi-threaded GIL-released scatter memcpy for
+  the flash-checkpoint HBM->shm hot path
+- :func:`crc32` — zlib-compatible checksum (native or zlib fallback)
+- :class:`TimerRing` — shared-memory timing ring (xpu_timer analogue)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_SRC_DIR, "build", "libdlrtpu.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_attempted = False
+
+
+class _CopySeg(ctypes.Structure):
+    # src is const char* on the C side; c_void_p lets us assign a raw
+    # numpy data address without ctypes trying to own the string
+    _fields_ = [
+        ("src", ctypes.c_void_p),
+        ("dst_offset", ctypes.c_uint64),
+        ("size", ctypes.c_uint64),
+    ]
+
+
+class _Record(ctypes.Structure):
+    _fields_ = [
+        ("tag", ctypes.c_uint64),
+        ("start_ns", ctypes.c_uint64),
+        ("dur_ns", ctypes.c_uint64),
+        ("seq", ctypes.c_uint64),  # seqlock word (see dlrtpu.cc)
+    ]
+
+
+def _try_build() -> bool:
+    src = os.path.join(_SRC_DIR, "dlrtpu.cc")
+    if not os.path.exists(src):
+        return False
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    # compile to a per-process temp file and os.replace it in: concurrent
+    # first-use builds from several worker processes each produce a
+    # complete .so and atomically install it — no process can ever CDLL a
+    # truncated file
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        os.environ.get("CXX", "g++"), "-O3", "-shared", "-fPIC",
+        "-pthread", "-std=c++17", "-o", tmp_path, src,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        os.replace(tmp_path, _LIB_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("libdlrtpu build failed (%s); using fallbacks", e)
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return False
+
+
+def _bind(lib):
+    lib.dlrtpu_scatter_copy.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_CopySeg), ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.dlrtpu_scatter_copy.restype = None
+    lib.dlrtpu_crc32.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32
+    ]
+    lib.dlrtpu_crc32.restype = ctypes.c_uint32
+    lib.dlrtpu_ring_bytes.argtypes = [ctypes.c_uint64]
+    lib.dlrtpu_ring_bytes.restype = ctypes.c_uint64
+    lib.dlrtpu_ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.dlrtpu_ring_init.restype = None
+    lib.dlrtpu_ring_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64
+    ]
+    lib.dlrtpu_ring_push.restype = None
+    lib.dlrtpu_ring_drain.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_Record), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.dlrtpu_ring_drain.restype = ctypes.c_uint64
+    return lib
+
+
+def get_lib():
+    """The loaded native library, or None (fallbacks in effect)."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("DLROVER_TPU_DISABLE_NATIVE"):
+            return None
+        try:
+            if not os.path.exists(_LIB_PATH):
+                if not _try_build():
+                    return None
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+            logger.info("libdlrtpu loaded from %s", _LIB_PATH)
+        except OSError as e:
+            logger.warning("libdlrtpu load failed (%s); using fallbacks", e)
+            _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+# ------------------------------------------------------------------ copy
+
+
+def scatter_copy(dst_buf, parts, nthreads: int = 8) -> bool:
+    """Copy ``parts`` = [(dst_offset, ndarray), ...] into ``dst_buf``
+    (a writable buffer, e.g. shm memoryview). Returns True if the native
+    path ran; False means the caller must fall back.
+
+    The C call releases the GIL and fans out over a thread pool, so
+    multi-GB checkpoint copies run at memory bandwidth instead of
+    single-thread numpy speed.
+    """
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None or not parts:
+        return lib is not None
+    dst = (ctypes.c_char * len(dst_buf)).from_buffer(dst_buf)
+    segs = (_CopySeg * len(parts))()
+    keepalive = []
+    for i, (offset, arr) in enumerate(parts):
+        flat = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+        if int(offset) + flat.nbytes > len(dst_buf):
+            raise ValueError(
+                f"scatter_copy overrun: offset {offset} + {flat.nbytes} "
+                f"bytes exceeds buffer of {len(dst_buf)}"
+            )
+        keepalive.append(flat)
+        segs[i].src = flat.ctypes.data
+        segs[i].dst_offset = int(offset)
+        segs[i].size = flat.nbytes
+    lib.dlrtpu_scatter_copy(
+        ctypes.addressof(dst), segs, len(parts), int(nthreads)
+    )
+    del dst
+    return True
+
+
+# ----------------------------------------------------------------- crc32
+
+
+def crc32(data, seed: int = 0) -> int:
+    """zlib-compatible CRC-32 (native when available)."""
+    lib = get_lib()
+    if lib is None:
+        import zlib
+
+        # zlib accepts any C-contiguous buffer directly: no copy
+        return zlib.crc32(data, seed) & 0xFFFFFFFF
+    import numpy as np
+
+    arr = np.frombuffer(data, dtype=np.uint8)
+    return int(lib.dlrtpu_crc32(
+        arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, seed
+    ))
+
+
+# ------------------------------------------------------------ timer ring
+
+
+class TimerRing:
+    """Shared-memory timing ring (the xpu_timer capability, TPU-style).
+
+    Training processes :meth:`push` (tag, start_ns, dur_ns) records —
+    e.g. per-step wall time, per-collective latency from the jax profiler
+    — into a shm segment; the agent :meth:`drain`-s and exports them.
+    Works without the native lib via a pure-Python layout-compatible path.
+    """
+
+    HEADER = 16  # uint64 capacity + uint64 head
+    REC = 32     # tag, start_ns, dur_ns, seq
+
+    def __init__(self, buf, capacity: int = 4096, init: bool = True):
+        """``buf``: writable buffer of at least ring_bytes(capacity)."""
+        self._buf = buf
+        self._capacity = capacity
+        self._cursor = ctypes.c_uint64(0)
+        self._cbuf = (ctypes.c_char * len(buf)).from_buffer(buf)
+        if init:
+            lib = get_lib()
+            if lib is not None:
+                lib.dlrtpu_ring_init(
+                    ctypes.addressof(self._cbuf), capacity
+                )
+            else:
+                self._py_init()
+
+    @classmethod
+    def ring_bytes(cls, capacity: int) -> int:
+        return cls.HEADER + capacity * cls.REC
+
+    # -- pure-python layout-compatible fallback ---------------------------
+
+    def _py_init(self):
+        import struct
+
+        self._buf[:16] = struct.pack("<QQ", self._capacity, 0)
+
+    def _py_push(self, tag, start_ns, dur_ns):
+        import struct
+
+        cap, head = struct.unpack("<QQ", bytes(self._buf[:16]))
+        slot = head % cap
+        off = self.HEADER + slot * self.REC
+        self._buf[off:off + self.REC] = struct.pack(
+            "<QQQQ", tag, start_ns, dur_ns, 2 * head + 2
+        )
+        self._buf[8:16] = struct.pack("<Q", head + 1)
+
+    def _py_drain(self, max_records):
+        import struct
+
+        cap, head = struct.unpack("<QQ", bytes(self._buf[:16]))
+        cur = self._cursor.value
+        if head > cur + cap:
+            cur = head - cap
+        out = []
+        while cur < head and len(out) < max_records:
+            off = self.HEADER + (cur % cap) * self.REC
+            tag, start_ns, dur_ns, seq = struct.unpack(
+                "<QQQQ", bytes(self._buf[off:off + self.REC])
+            )
+            cur += 1
+            if seq != 2 * (cur - 1) + 2:
+                continue  # uncommitted or overwritten slot
+            out.append((tag, start_ns, dur_ns))
+        self._cursor.value = cur
+        return out
+
+    # -- API ---------------------------------------------------------------
+
+    def push(self, tag: int, start_ns: int, dur_ns: int):
+        lib = get_lib()
+        if lib is None:
+            self._py_push(tag, start_ns, dur_ns)
+            return
+        lib.dlrtpu_ring_push(
+            ctypes.addressof(self._cbuf), tag, start_ns, dur_ns
+        )
+
+    def drain(self, max_records: int = 1024) -> list:
+        """Returns [(tag, start_ns, dur_ns), ...] since the last drain."""
+        lib = get_lib()
+        if lib is None:
+            return self._py_drain(max_records)
+        out = (_Record * max_records)()
+        n = lib.dlrtpu_ring_drain(
+            ctypes.addressof(self._cbuf), out, max_records,
+            ctypes.byref(self._cursor),
+        )
+        return [
+            (out[i].tag, out[i].start_ns, out[i].dur_ns)
+            for i in range(n)
+        ]

@@ -1,0 +1,77 @@
+"""StepTimer: per-process timing into the shared timing ring.
+
+Equivalent capability: reference atorch/dev/xpu_timer — a native library
+that times GEMMs/collectives in the training process and exports them via
+shared memory to an out-of-process exporter. TPU redesign: XLA kernels
+can't be LD_PRELOAD-hooked, so timing happens at the step/phase level
+(wall time around jitted calls, D2H checkpoint copies, data waits) and is
+pushed into the libdlrtpu shm ring; the agent's TimerRingExporter drains
+and aggregates it (dlrover_tpu/agent/monitor.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+from dlrover_tpu.common.ipc import get_or_create_shm
+from dlrover_tpu.native import TimerRing
+
+
+class Tag:
+    STEP = 1          # one training step (wall)
+    DATA_WAIT = 2     # blocked on host input pipeline
+    CKPT_SHM = 3      # checkpoint D2H + shm write
+    CKPT_PERSIST = 4  # shm -> storage persist
+    COMPILE = 5       # jit compilation
+
+    NAMES = {1: "step", 2: "data_wait", 3: "ckpt_shm",
+             4: "ckpt_persist", 5: "compile"}
+
+
+_RING_CAPACITY = 8192
+_timer = None
+
+
+def ring_shm_name() -> str:
+    job = os.environ.get("ELASTIC_JOB_NAME", "local")
+    return f"dlrtpu_timer_{job}"
+
+
+class StepTimer:
+    """Pushes timing records into the host-wide shm ring. Safe from many
+    processes concurrently (seqlock slots)."""
+
+    def __init__(self):
+        size = TimerRing.ring_bytes(_RING_CAPACITY)
+        self._shm = get_or_create_shm(ring_shm_name(), size)
+        created = getattr(self._shm, "just_created", True)
+        self._ring = TimerRing(
+            self._shm.buf, _RING_CAPACITY, init=created
+        )
+
+    def record(self, tag: int, start_ns: int, dur_ns: int):
+        self._ring.push(tag, start_ns, dur_ns)
+
+    @contextlib.contextmanager
+    def time(self, tag: int):
+        t0 = time.time_ns()
+        try:
+            yield
+        finally:
+            self._ring.push(tag, t0, time.time_ns() - t0)
+
+    def drain(self, max_records: int = 4096) -> list:
+        return self._ring.drain(max_records)
+
+    def close(self):
+        self._shm.close()
+
+
+def get_step_timer() -> StepTimer:
+    """Process-wide singleton (attaches to the host ring)."""
+    global _timer
+    if _timer is None:
+        _timer = StepTimer()
+    return _timer

@@ -1,0 +1,201 @@
+// libdlrtpu: native runtime helpers for the TPU framework.
+//
+// Equivalent capability: the reference's native runtime pieces —
+// atorch/dev/xpu_timer (C++ LD_PRELOAD profiler exporting GEMM/collective
+// timings via a shared ring) and the C++/CUDA copy/quantization kernels
+// under atorch/atorch/ops/csrc/. TPU redesign: the checkpoint hot path is
+// an HBM->host-shm scatter copy (engine._write_shm_locked); doing it here
+// with a thread pool releases the GIL and saturates host memory bandwidth,
+// and crc32 gives end-to-end shard integrity. The timing ring is the
+// xpu_timer analogue: training processes push (tag, start, duration)
+// records into a shared-memory ring; the agent drains and exports them.
+//
+// Build: g++ -O3 -shared -fPIC -pthread -o libdlrtpu.so dlrtpu.cc
+// (driven by dlrover_tpu/native/__init__.py, with a pure-Python fallback).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- copy
+
+struct CopySeg {
+  const char* src;
+  uint64_t dst_offset;
+  uint64_t size;
+};
+
+// Copy n segments into dst using up to nthreads threads. Large segments
+// are split into 8 MiB chunks so threads balance regardless of segment
+// size distribution.
+void dlrtpu_scatter_copy(char* dst, const CopySeg* segs, uint64_t n,
+                         int nthreads) {
+  if (n == 0) return;
+  constexpr uint64_t kChunk = 8ull << 20;
+  struct Chunk {
+    const char* src;
+    char* dst;
+    uint64_t size;
+  };
+  std::vector<Chunk> chunks;
+  chunks.reserve(n);
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t off = 0;
+    while (off < segs[i].size) {
+      uint64_t sz = segs[i].size - off;
+      if (sz > kChunk) sz = kChunk;
+      chunks.push_back(
+          {segs[i].src + off, dst + segs[i].dst_offset + off, sz});
+      off += sz;
+    }
+  }
+  if (nthreads < 1) nthreads = 1;
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw > 0 && (unsigned)nthreads > hw) nthreads = (int)hw;
+  if ((uint64_t)nthreads > chunks.size()) nthreads = (int)chunks.size();
+  if (nthreads <= 1) {
+    for (const auto& c : chunks) std::memcpy(c.dst, c.src, c.size);
+    return;
+  }
+  std::atomic<uint64_t> next{0};
+  auto worker = [&]() {
+    for (;;) {
+      uint64_t i = next.fetch_add(1, std::memory_order_relaxed);
+      if (i >= chunks.size()) return;
+      std::memcpy(chunks[i].dst, chunks[i].src, chunks[i].size);
+    }
+  };
+  std::vector<std::thread> pool;
+  pool.reserve(nthreads - 1);
+  for (int t = 1; t < nthreads; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+}
+
+// ---------------------------------------------------------------- crc32
+
+static uint32_t g_crc_table[256];
+static std::atomic<bool> g_crc_init{false};
+
+static void crc_init() {
+  bool expected = false;
+  static std::atomic<bool> building{false};
+  if (g_crc_init.load(std::memory_order_acquire)) return;
+  if (building.compare_exchange_strong(expected, true)) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      g_crc_table[i] = c;
+    }
+    g_crc_init.store(true, std::memory_order_release);
+  } else {
+    while (!g_crc_init.load(std::memory_order_acquire)) {
+    }
+  }
+}
+
+// Standard zlib-compatible CRC-32; seed 0 starts a new checksum, pass a
+// previous result to continue (streaming).
+uint32_t dlrtpu_crc32(const uint8_t* data, uint64_t len, uint32_t seed) {
+  crc_init();
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < len; ++i)
+    c = g_crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------- timing ring
+
+// Layout in caller-provided (shared) memory:
+//   [0]  uint64 capacity (records)
+//   [8]  atomic uint64 head (monotonic record count; slot reservation)
+//   [16] Record[capacity]
+//
+// Each record carries a seqlock word: a writer reserves global index i
+// via head.fetch_add, marks the slot "writing" (seq = 2i+1), writes the
+// fields, then commits (seq = 2i+2, release). A reader accepts a slot
+// only when seq == 2i+2 before AND after copying the fields, so torn or
+// in-progress records are never returned.
+struct Record {
+  uint64_t tag;
+  uint64_t start_ns;
+  uint64_t dur_ns;
+  std::atomic<uint64_t> seq;
+};
+
+struct RingHeader {
+  uint64_t capacity;
+  std::atomic<uint64_t> head;
+};
+
+uint64_t dlrtpu_ring_bytes(uint64_t capacity) {
+  return sizeof(RingHeader) + capacity * sizeof(Record);
+}
+
+void dlrtpu_ring_init(void* buf, uint64_t capacity) {
+  auto* h = reinterpret_cast<RingHeader*>(buf);
+  auto* recs = reinterpret_cast<Record*>(
+      reinterpret_cast<char*>(buf) + sizeof(RingHeader));
+  h->capacity = capacity;
+  for (uint64_t i = 0; i < capacity; ++i)
+    recs[i].seq.store(0, std::memory_order_relaxed);
+  h->head.store(0, std::memory_order_release);
+}
+
+void dlrtpu_ring_push(void* buf, uint64_t tag, uint64_t start_ns,
+                      uint64_t dur_ns) {
+  auto* h = reinterpret_cast<RingHeader*>(buf);
+  auto* recs = reinterpret_cast<Record*>(
+      reinterpret_cast<char*>(buf) + sizeof(RingHeader));
+  uint64_t i = h->head.fetch_add(1, std::memory_order_acq_rel);
+  Record& r = recs[i % h->capacity];
+  r.seq.store(2 * i + 1, std::memory_order_release);  // writing
+  r.tag = tag;
+  r.start_ns = start_ns;
+  r.dur_ns = dur_ns;
+  r.seq.store(2 * i + 2, std::memory_order_release);  // committed
+}
+
+// Copy committed records in [*cursor, head) into out (up to max).
+// Advances *cursor. Slots overwritten by a later lap are skipped; slots
+// not yet committed stop the drain (they'll be picked up next time).
+uint64_t dlrtpu_ring_drain(void* buf, Record* out, uint64_t max,
+                           uint64_t* cursor) {
+  auto* h = reinterpret_cast<RingHeader*>(buf);
+  auto* recs = reinterpret_cast<Record*>(
+      reinterpret_cast<char*>(buf) + sizeof(RingHeader));
+  uint64_t head = h->head.load(std::memory_order_acquire);
+  uint64_t cur = *cursor;
+  if (head > cur + h->capacity) cur = head - h->capacity;  // lost records
+  uint64_t n = 0;
+  while (cur < head && n < max) {
+    Record& slot = recs[cur % h->capacity];
+    uint64_t want = 2 * cur + 2;
+    uint64_t s1 = slot.seq.load(std::memory_order_acquire);
+    if (s1 < want) break;      // reserved/writing, not committed yet
+    if (s1 > want) {           // overwritten by a later lap
+      ++cur;
+      continue;
+    }
+    out[n].tag = slot.tag;
+    out[n].start_ns = slot.start_ns;
+    out[n].dur_ns = slot.dur_ns;
+    uint64_t s2 = slot.seq.load(std::memory_order_acquire);
+    if (s2 != want) {          // overwritten mid-copy: discard
+      ++cur;
+      continue;
+    }
+    out[n].seq.store(want, std::memory_order_relaxed);
+    ++n;
+    ++cur;
+  }
+  *cursor = cur;
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,215 @@
+"""Tests for the native runtime library (libdlrtpu): scatter copy,
+crc32, timing ring — and its integration in the flash-checkpoint engine.
+Reference analogue: atorch ops builder tests + xpu_timer.
+"""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from dlrover_tpu import native
+
+
+def require_native():
+    if not native.native_available():
+        pytest.skip("libdlrtpu unavailable (no toolchain)")
+
+
+class TestScatterCopy:
+    def test_matches_sequential(self):
+        require_native()
+        rng = np.random.RandomState(0)
+        arrays = [
+            rng.randn(37).astype(np.float32),
+            rng.randint(0, 255, size=(513,)).astype(np.uint8),
+            rng.randn(100, 7).astype(np.float64),
+        ]
+        total = sum(a.nbytes for a in arrays)
+        dst = bytearray(total)
+        parts, off = [], 0
+        for a in arrays:
+            parts.append((off, a))
+            off += a.nbytes
+        assert native.scatter_copy(dst, parts)
+        expected = b"".join(
+            np.ascontiguousarray(a).tobytes() for a in arrays
+        )
+        assert bytes(dst) == expected
+
+    def test_large_multithreaded(self):
+        require_native()
+        a = np.arange(3 << 20, dtype=np.uint8)  # 3 MiB
+        b = np.arange(17 << 20, dtype=np.uint8)  # 17 MiB (chunk split)
+        dst = bytearray(a.nbytes + b.nbytes)
+        assert native.scatter_copy(
+            dst, [(0, a), (a.nbytes, b)], nthreads=4
+        )
+        assert bytes(dst[:16]) == a.tobytes()[:16]
+        assert bytes(dst[a.nbytes:a.nbytes + 16]) == b.tobytes()[:16]
+        assert dst[-1] == b.tobytes()[-1]
+
+    def test_noncontiguous_source(self):
+        require_native()
+        base = np.arange(100, dtype=np.int32).reshape(10, 10)
+        view = base[:, ::2]  # non-contiguous
+        dst = bytearray(view.nbytes)
+        assert native.scatter_copy(dst, [(0, view)])
+        assert bytes(dst) == np.ascontiguousarray(view).tobytes()
+
+
+class TestCrc32:
+    def test_matches_zlib(self):
+        require_native()
+        data = os.urandom(10000)
+        assert native.crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+    def test_streaming(self):
+        require_native()
+        data = os.urandom(5000)
+        part = native.crc32(data[:2000])
+        full = native.crc32(data[2000:], seed=part)
+        assert full == zlib.crc32(data) & 0xFFFFFFFF
+
+
+class TestTimerRing:
+    def _ring(self, capacity=8):
+        buf = bytearray(native.TimerRing.ring_bytes(capacity))
+        return native.TimerRing(buf, capacity)
+
+    def test_push_drain(self):
+        ring = self._ring()
+        ring.push(1, 100, 10)
+        ring.push(2, 200, 20)
+        recs = ring.drain()
+        assert recs == [(1, 100, 10), (2, 200, 20)]
+        assert ring.drain() == []
+
+    def test_wraparound_skips_lost(self):
+        ring = self._ring(capacity=4)
+        for i in range(10):
+            ring.push(i, i, i)
+        recs = ring.drain()
+        # only the last 4 survive
+        assert [r[0] for r in recs] == [6, 7, 8, 9]
+
+    def test_python_fallback_layout_compatible(self, monkeypatch):
+        """Records pushed by the fallback are drainable by the native
+        path and vice versa (same shm layout)."""
+        require_native()
+        buf = bytearray(native.TimerRing.ring_bytes(8))
+        ring = native.TimerRing(buf, 8)
+        ring._py_push(7, 70, 7)
+        ring.push(8, 80, 8)
+        assert ring.drain() == [(7, 70, 7), (8, 80, 8)]
+
+
+class TestStepTimerPlumbing:
+    def test_trainer_push_agent_drain(self, tmp_path, monkeypatch):
+        """StepTimer (trainer side) -> shm ring -> TimerRingExporter
+        (agent side) aggregates and writes the stats file."""
+        monkeypatch.setenv("ELASTIC_JOB_NAME", f"timer{os.getpid()}")
+        import dlrover_tpu.trainer.timer as timer_mod
+        from dlrover_tpu.agent.monitor import TimerRingExporter
+        from dlrover_tpu.trainer.timer import Tag, get_step_timer
+
+        monkeypatch.setattr(timer_mod, "_timer", None)
+        t = get_step_timer()
+        try:
+            with t.time(Tag.STEP):
+                pass
+            t.record(Tag.CKPT_SHM, 0, 5_000_000)  # 5ms
+            exporter = TimerRingExporter(
+                out_path=str(tmp_path / "timer_stats.json")
+            )
+            exporter._timer = t
+            stats = exporter.export_once()
+            assert stats["ckpt_shm"]["count"] == 1
+            assert stats["ckpt_shm"]["avg_ms"] == 5.0
+            assert stats["step"]["count"] == 1
+            import json
+
+            on_disk = json.load(open(tmp_path / "timer_stats.json"))
+            assert on_disk["ckpt_shm"]["avg_ms"] == 5.0
+        finally:
+            t._shm.close()
+            try:
+                t._shm.unlink()
+            except FileNotFoundError:
+                pass
+            monkeypatch.setattr(timer_mod, "_timer", None)
+
+
+class TestCrcShardPath:
+    def test_corrupt_shard_rejected(self, tmp_path):
+        import pickle
+
+        from dlrover_tpu.agent.ckpt_saver import (
+            CheckpointMeta,
+            read_host_shard,
+            write_host_shard,
+        )
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        storage = PosixDiskStorage()
+        path = str(tmp_path / "host_0.dlck")
+        payload = os.urandom(1000)
+        meta = CheckpointMeta(step=7, total_bytes=len(payload))
+        write_host_shard(storage, path, meta, payload)
+        got = read_host_shard(path)
+        assert got is not None and got[0].payload_crc >= 0
+        assert got[1] == payload
+
+        # flip one payload byte -> read must reject
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+        assert read_host_shard(path) is None
+
+
+class TestEngineIntegration:
+    def test_checkpoint_bytes_identical_with_and_without_native(
+        self, tmp_path, monkeypatch
+    ):
+        """The shm image written via native scatter_copy must be byte-
+        identical to the numpy fallback path."""
+        require_native()
+        import jax.numpy as jnp
+
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            ReplicatedCheckpointEngine,
+        )
+
+        monkeypatch.setenv(
+            "DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks")
+        )
+        state = {
+            "w": jnp.arange(1024, dtype=jnp.float32).reshape(32, 32),
+            "b": jnp.ones((7,), jnp.bfloat16),
+        }
+
+        def snapshot(disable_native, tag):
+            monkeypatch.setattr(native, "_lib", None)
+            monkeypatch.setattr(
+                native, "_load_attempted", disable_native
+            )
+            monkeypatch.setenv("ELASTIC_JOB_NAME", f"nat{tag}")
+            engine = ReplicatedCheckpointEngine(
+                str(tmp_path / f"ckpt{tag}")
+            )
+            try:
+                assert engine.save_to_memory(3, state)
+                _meta, data = engine._shm_handler.read()
+                return bytes(data)
+            finally:
+                engine._shm_handler.close(unlink=True)
+                engine.close()
+
+        with_native = snapshot(False, "a")
+        without = snapshot(True, "b")
+        assert with_native == without
+
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        AsyncCheckpointSaver.reset()
