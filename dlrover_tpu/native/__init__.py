@@ -10,7 +10,7 @@ the framework works without a toolchain.
 Surface:
 - :func:`scatter_copy` — multi-threaded GIL-released scatter memcpy for
   the flash-checkpoint HBM->shm hot path
-- :func:`crc32` — zlib-compatible checksum (native or zlib fallback)
+- :func:`crc32` — zlib-compatible checksum (always zlib; see docstring)
 - :class:`TimerRing` — shared-memory timing ring (xpu_timer analogue)
 """
 
@@ -90,10 +90,6 @@ def _bind(lib):
         ctypes.c_int,
     ]
     lib.dlrtpu_scatter_copy.restype = None
-    lib.dlrtpu_crc32.argtypes = [
-        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32
-    ]
-    lib.dlrtpu_crc32.restype = ctypes.c_uint32
     lib.dlrtpu_ring_bytes.argtypes = [ctypes.c_uint64]
     lib.dlrtpu_ring_bytes.restype = ctypes.c_uint64
     lib.dlrtpu_ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -179,19 +175,15 @@ def scatter_copy(dst_buf, parts, nthreads: int = 8) -> bool:
 
 
 def crc32(data, seed: int = 0) -> int:
-    """zlib-compatible CRC-32 (native when available)."""
-    lib = get_lib()
-    if lib is None:
-        import zlib
+    """zlib-compatible CRC-32.
 
-        # zlib accepts any C-contiguous buffer directly: no copy
-        return zlib.crc32(data, seed) & 0xFFFFFFFF
-    import numpy as np
+    Always zlib: its slice-by-N implementation is ~5x faster than a
+    byte-at-a-time C table loop and already releases the GIL, so a
+    "native" path here would be a pessimization on multi-GB shards
+    (measured: 64 MiB in 0.033s zlib vs 0.170s table-loop)."""
+    import zlib
 
-    arr = np.frombuffer(data, dtype=np.uint8)
-    return int(lib.dlrtpu_crc32(
-        arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, seed
-    ))
+    return zlib.crc32(data, seed) & 0xFFFFFFFF
 
 
 # ------------------------------------------------------------ timer ring
@@ -209,11 +201,17 @@ class TimerRing:
     HEADER = 16  # uint64 capacity + uint64 head
     REC = 32     # tag, start_ns, dur_ns, seq
 
-    def __init__(self, buf, capacity: int = 4096, init: bool = True):
-        """``buf``: writable buffer of at least ring_bytes(capacity)."""
+    def __init__(self, buf, capacity: int = 4096, init: bool = True,
+                 lock_path: str | None = None):
+        """``buf``: writable buffer of at least ring_bytes(capacity).
+
+        ``lock_path``: advisory file lock used by the pure-Python
+        fallback to make cross-process push/drain atomic (the native
+        path needs no lock — per-slot seqlocks)."""
         self._buf = buf
         self._capacity = capacity
         self._cursor = ctypes.c_uint64(0)
+        self._lock_path = lock_path
         self._cbuf = (ctypes.c_char * len(buf)).from_buffer(buf)
         if init:
             lib = get_lib()
@@ -229,6 +227,27 @@ class TimerRing:
         return cls.HEADER + capacity * cls.REC
 
     # -- pure-python layout-compatible fallback ---------------------------
+    # NOT lock-free: the head read-modify-write needs the advisory file
+    # lock for multi-process safety (single-process use needs nothing).
+
+    def _py_lock(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def locked():
+            if self._lock_path is None:
+                yield
+                return
+            import fcntl
+
+            with open(self._lock_path, "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+
+        return locked()
 
     def _py_init(self):
         import struct
@@ -238,6 +257,10 @@ class TimerRing:
     def _py_push(self, tag, start_ns, dur_ns):
         import struct
 
+        with self._py_lock():
+            self._py_push_locked(tag, start_ns, dur_ns, struct)
+
+    def _py_push_locked(self, tag, start_ns, dur_ns, struct):
         cap, head = struct.unpack("<QQ", bytes(self._buf[:16]))
         slot = head % cap
         off = self.HEADER + slot * self.REC

@@ -1,0 +1,217 @@
+"""KvEmbedding: dynamic sparse embedding tables, TPU-idiomatic.
+
+Equivalent capability: reference TFPlus KvVariable
+(tfplus/tfplus/kv_variable/kernels/kv_variable.h — libcuckoo hash table of
+id -> embedding, lazy init, frequency tracking, under-threshold eviction
+on export; ops kv_variable_ops.cc:37-466) and its Python wrappers
+(python/ops/kv_variable_ops.py, embedding_ops.py).
+
+TPU redesign: XLA wants static shapes, so the device side is a fixed-
+capacity ``[capacity, dim]`` table (rows shard over the mesh like any
+other parameter; lookups are a ``take`` that XLA lowers to efficient
+dynamic-gather, and gradients flow through standard autodiff as
+scatter-adds). The *dynamic* part lives on the host: an :class:`IdMapper`
+assigns raw feature ids to table slots on first sight (the "insert on
+lookup" semantics of KvVariable), tracks per-id frequencies, and evicts
+cold ids to recycle slots — all outside jit, so the compiled step never
+changes shape. Export/import round-trips (id, vector, freq) triples with
+under-threshold filtering, matching KvVariableExport/Import semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class IdMapper:
+    """Host-side id -> slot assignment with frequencies and eviction."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._slot_of: dict[int, int] = {}
+        self._freq: dict[int, int] = {}
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+
+    def __len__(self):
+        return len(self._slot_of)
+
+    def lookup(self, ids: np.ndarray, count: bool = True) -> np.ndarray:
+        """Map raw ids to slots, inserting unseen ids (KvVariable's
+        gather-or-insert). Raises when the table is full — callers evict
+        first. Capacity is validated up front so a failed batch mutates
+        nothing (safe to evict and retry the same batch)."""
+        flat = np.asarray(ids).reshape(-1)
+        raws = flat.tolist()
+        out = np.empty(flat.shape, np.int32)
+        with self._lock:
+            unseen = {r for r in raws if r not in self._slot_of}
+            if len(unseen) > len(self._free):
+                raise RuntimeError(
+                    f"KvEmbedding capacity {self.capacity} exhausted "
+                    f"({len(unseen)} new ids, {len(self._free)} free "
+                    "slots); evict() first"
+                )
+            for i, raw in enumerate(raws):
+                slot = self._slot_of.get(raw)
+                if slot is None:
+                    slot = self._free.pop()
+                    self._slot_of[raw] = slot
+                    self._freq[raw] = 0
+                if count:
+                    self._freq[raw] += 1
+                out[i] = slot
+        return out.reshape(np.shape(ids))
+
+    def frequencies(self, ids) -> np.ndarray:
+        flat = np.asarray(ids).reshape(-1)
+        with self._lock:
+            return np.array(
+                [self._freq.get(int(i), 0) for i in flat], np.int64
+            ).reshape(np.shape(ids))
+
+    def evict_under_threshold(self, threshold: int) -> list[int]:
+        """Free the slots of ids seen fewer than ``threshold`` times
+        (the reference's under-threshold export filtering / eviction).
+        Returns the freed slot indices (caller may zero those rows)."""
+        freed = []
+        with self._lock:
+            cold = [
+                raw for raw, f in self._freq.items() if f < threshold
+            ]
+            for raw in cold:
+                slot = self._slot_of.pop(raw)
+                del self._freq[raw]
+                self._free.append(slot)
+                freed.append(slot)
+        if freed:
+            logger.info("evicted %d cold ids", len(freed))
+        return freed
+
+    # ------------------------------------------------------- checkpoints
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "slot_of": dict(self._slot_of),
+                "freq": dict(self._freq),
+            }
+
+    def load_state_dict(self, state: dict):
+        with self._lock:
+            self.capacity = int(state["capacity"])
+            self._slot_of = {
+                int(k): int(v) for k, v in state["slot_of"].items()
+            }
+            self._freq = {
+                int(k): int(v) for k, v in state["freq"].items()
+            }
+            used = set(self._slot_of.values())
+            self._free = [
+                s for s in range(self.capacity - 1, -1, -1)
+                if s not in used
+            ]
+
+
+class KvEmbedding:
+    """A dynamic embedding table: host mapper + device parameter rows.
+
+    Typical flow::
+
+        kv = KvEmbedding(dim=64, capacity=1 << 17)
+        table = kv.init_table(jax.random.key(0))        # param leaf
+        slots = kv.lookup_slots(raw_ids)                # host, pre-step
+        vecs = KvEmbedding.embed(table, slots)          # inside jit
+        # table is trained like any parameter (shard rows on 'fsdp')
+
+    ``logical_axes`` for the table is ``("vocab", "embed")`` so
+    auto_accelerate shards rows across the mesh.
+    """
+
+    logical_axes = ("vocab", "embed")
+
+    def __init__(self, dim: int, capacity: int = 1 << 16,
+                 init_scale: float = 0.01, dtype=None):
+        self.dim = int(dim)
+        self.capacity = int(capacity)
+        self.init_scale = init_scale
+        self.dtype = dtype
+        self.mapper = IdMapper(capacity)
+
+    def init_table(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        dtype = self.dtype or jnp.float32
+        return (
+            jax.random.normal(rng, (self.capacity, self.dim), dtype)
+            * self.init_scale
+        )
+
+    def lookup_slots(self, raw_ids) -> np.ndarray:
+        return self.mapper.lookup(raw_ids)
+
+    @staticmethod
+    def embed(table, slots):
+        """Device-side gather (use inside jit; differentiable)."""
+        import jax.numpy as jnp
+
+        return jnp.take(table, slots, axis=0)
+
+    # ------------------------------------------------------- ckpt/export
+
+    def export(self, table, min_frequency: int = 0):
+        """Returns (ids, vectors, freqs), optionally dropping ids seen
+        fewer than ``min_frequency`` times (KvVariableExport semantics).
+        """
+        host_table = np.asarray(table)
+        state = self.mapper.state_dict()
+        ids, rows, freqs = [], [], []
+        for raw, slot in state["slot_of"].items():
+            f = state["freq"].get(raw, 0)
+            if f < min_frequency:
+                continue
+            ids.append(raw)
+            rows.append(host_table[slot])
+            freqs.append(f)
+        if not ids:
+            return (
+                np.zeros((0,), np.int64),
+                np.zeros((0, self.dim), host_table.dtype),
+                np.zeros((0,), np.int64),
+            )
+        return (
+            np.asarray(ids, np.int64),
+            np.stack(rows),
+            np.asarray(freqs, np.int64),
+        )
+
+    def import_(self, table, ids, vectors, freqs=None):
+        """Load (id, vector, freq) triples; returns the updated table
+        (KvVariableImport). Ids get fresh slots in THIS mapper."""
+        import jax.numpy as jnp
+
+        slots = self.mapper.lookup(ids, count=False)
+        if freqs is not None:
+            with self.mapper._lock:
+                for raw, f in zip(np.asarray(ids).tolist(),
+                                  np.asarray(freqs).tolist()):
+                    self.mapper._freq[int(raw)] = int(f)
+        return jnp.asarray(table).at[slots].set(jnp.asarray(vectors))
+
+    def evict(self, table, threshold: int):
+        """Drop cold ids and zero their rows; returns the new table."""
+        import jax.numpy as jnp
+
+        freed = self.mapper.evict_under_threshold(threshold)
+        if not freed:
+            return table
+        idx = np.asarray(freed, np.int32)
+        return jnp.asarray(table).at[idx].set(0.0)

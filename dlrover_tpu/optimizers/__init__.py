@@ -1,6 +1,7 @@
 from dlrover_tpu.optimizers.agd import agd, scale_by_agd
 from dlrover_tpu.optimizers.wsam import make_wsam_grad_fn, wsam_update
 from dlrover_tpu.optimizers.low_bit import adam8bit, scale_by_adam8bit
+from dlrover_tpu.optimizers.group_sparse import group_adagrad, group_adam
 
 __all__ = [
     "agd",
@@ -9,4 +10,6 @@ __all__ = [
     "wsam_update",
     "adam8bit",
     "scale_by_adam8bit",
+    "group_adam",
+    "group_adagrad",
 ]

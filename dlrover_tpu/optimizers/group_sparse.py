@@ -1,0 +1,140 @@
+"""Group sparse optimizers: per-row adaptive state for embedding tables.
+
+Equivalent capability: reference TFPlus sparse optimizers
+(tfplus/tfplus/kv_variable/ops/training_ops.cc:103-571 — Group Adam /
+Adagrad / FTRL apply kernels; Python wrappers python/training/
+group_adam.py etc.). "Group" = each embedding row is an optimization
+group: moments and bias-correction step counts advance only on steps
+where the row was actually touched, so rarely-seen features keep
+fresh adaptive scales instead of being decayed by millions of steps
+they never participated in.
+
+TPU redesign: rows touched in a step are exactly the rows with nonzero
+gradient (gather autodiff produces zero rows elsewhere); the update is a
+dense masked computation — XLA fuses the mask into the moment updates,
+and everything shards row-wise over the mesh like the table itself.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class GroupAdamState(NamedTuple):
+    steps: optax.Updates  # per-row update counts [rows, 1]
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def _row_mask(g):
+    """[rows, 1] float mask of rows with any nonzero gradient."""
+    if g.ndim < 2:
+        return (g != 0).astype(g.dtype)
+    reduced = jnp.any(g != 0, axis=tuple(range(1, g.ndim)), keepdims=True)
+    return reduced.astype(g.dtype)
+
+
+def group_adam(
+    learning_rate: float | optax.Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Adam whose moments/bias-correction advance per-row (GroupAdam)."""
+
+    def init_fn(params):
+        def zeros_steps(p):
+            if p.ndim == 0:
+                return jnp.zeros((), jnp.int32)
+            return jnp.zeros(
+                (p.shape[0],) + (1,) * (p.ndim - 1), jnp.int32
+            )
+
+        return GroupAdamState(
+            steps=jax.tree.map(zeros_steps, params),
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        masks = jax.tree.map(_row_mask, updates)
+        steps = jax.tree.map(
+            lambda s, m: s + m.astype(jnp.int32), state.steps, masks
+        )
+        mu = jax.tree.map(
+            lambda mo, g, m: jnp.where(
+                m > 0, b1 * mo + (1 - b1) * g, mo
+            ),
+            state.mu, updates, masks,
+        )
+        nu = jax.tree.map(
+            lambda v, g, m: jnp.where(
+                m > 0, b2 * v + (1 - b2) * g * g, v
+            ),
+            state.nu, updates, masks,
+        )
+
+        def corrected(mo, v, s, m):
+            t = jnp.maximum(s, 1).astype(mo.dtype)
+            mo_hat = mo / (1 - b1**t)
+            v_hat = v / (1 - b2**t)
+            upd = mo_hat / (jnp.sqrt(v_hat) + eps)
+            return jnp.where(m > 0, upd, jnp.zeros_like(upd))
+
+        new_updates = jax.tree.map(corrected, mu, nu, steps, masks)
+        if weight_decay:
+            assert params is not None, "weight decay needs params"
+            new_updates = jax.tree.map(
+                lambda u, p, m: u + weight_decay * p * (m > 0),
+                new_updates, params, masks,
+            )
+        return new_updates, GroupAdamState(steps=steps, mu=mu, nu=nu)
+
+    return optax.chain(
+        optax.GradientTransformation(init_fn, update_fn),
+        optax.scale_by_learning_rate(learning_rate),
+    )
+
+
+class GroupAdagradState(NamedTuple):
+    accum: optax.Updates
+
+
+def group_adagrad(
+    learning_rate: float | optax.Schedule = 1e-2,
+    initial_accumulator: float = 0.1,
+    eps: float = 1e-10,
+) -> optax.GradientTransformation:
+    """Adagrad with per-row accumulators (GroupAdagrad analogue)."""
+
+    def init_fn(params):
+        return GroupAdagradState(
+            accum=jax.tree.map(
+                lambda p: jnp.full_like(p, initial_accumulator), params
+            ),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        masks = jax.tree.map(_row_mask, updates)
+        accum = jax.tree.map(
+            lambda a, g, m: jnp.where(m > 0, a + g * g, a),
+            state.accum, updates, masks,
+        )
+        new_updates = jax.tree.map(
+            lambda g, a, m: jnp.where(
+                m > 0, g / (jnp.sqrt(a) + eps), jnp.zeros_like(g)
+            ),
+            updates, accum, masks,
+        )
+        return new_updates, GroupAdagradState(accum=accum)
+
+    return optax.chain(
+        optax.GradientTransformation(init_fn, update_fn),
+        optax.scale_by_learning_rate(learning_rate),
+    )

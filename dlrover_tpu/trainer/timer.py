@@ -40,16 +40,36 @@ def ring_shm_name() -> str:
 
 
 class StepTimer:
-    """Pushes timing records into the host-wide shm ring. Safe from many
-    processes concurrently (seqlock slots)."""
+    """Pushes timing records into the host-wide shm ring.
+
+    Concurrent pushers are safe via the ring's per-slot seqlocks (native
+    path) or an advisory file lock (pure-Python fallback). Creation +
+    header init happen under a file lock so an attacher can never map a
+    zero-capacity header (which would make the native push divide by
+    zero)."""
 
     def __init__(self):
+        import fcntl
+
         size = TimerRing.ring_bytes(_RING_CAPACITY)
-        self._shm = get_or_create_shm(ring_shm_name(), size)
-        created = getattr(self._shm, "just_created", True)
-        self._ring = TimerRing(
-            self._shm.buf, _RING_CAPACITY, init=created
+        lock_dir = os.environ.get(
+            "DLROVER_TPU_SOCKET_DIR", "/tmp/dlrover_tpu"
         )
+        os.makedirs(lock_dir, exist_ok=True)
+        self._lock_path = os.path.join(
+            lock_dir, f"{ring_shm_name()}.lock"
+        )
+        with open(self._lock_path, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                self._shm = get_or_create_shm(ring_shm_name(), size)
+                created = getattr(self._shm, "just_created", True)
+                self._ring = TimerRing(
+                    self._shm.buf, _RING_CAPACITY, init=created,
+                    lock_path=self._lock_path,
+                )
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
 
     def record(self, tag: int, start_ns: int, dur_ns: int):
         self._ring.push(tag, start_ns, dur_ns)

@@ -1,0 +1,195 @@
+"""Tests for KvEmbedding (dynamic sparse embedding) and group sparse
+optimizers — reference coverage analogue: tfplus py_ut kv_variable and
+group optimizer tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.ops.sparse_embedding import IdMapper, KvEmbedding
+from dlrover_tpu.optimizers import group_adagrad, group_adam
+
+
+class TestIdMapper:
+    def test_insert_on_lookup(self):
+        m = IdMapper(8)
+        slots = m.lookup(np.array([100, 200, 100]))
+        assert slots[0] == slots[2] != slots[1]
+        assert len(m) == 2
+
+    def test_frequencies(self):
+        m = IdMapper(8)
+        m.lookup(np.array([5, 5, 7]))
+        assert m.frequencies(np.array([5, 7, 9])).tolist() == [2, 1, 0]
+
+    def test_capacity_exhaustion(self):
+        m = IdMapper(2)
+        m.lookup(np.array([1, 2]))
+        with pytest.raises(RuntimeError, match="capacity"):
+            m.lookup(np.array([3]))
+
+    def test_eviction_recycles_slots(self):
+        m = IdMapper(2)
+        m.lookup(np.array([1, 1, 2]))  # freq: 1->2, 2->1
+        freed = m.evict_under_threshold(2)
+        assert len(freed) == 1
+        # slot is reusable now
+        m.lookup(np.array([3]))
+        assert len(m) == 2
+
+    def test_state_roundtrip(self):
+        m = IdMapper(8)
+        m.lookup(np.array([10, 20, 10]))
+        state = m.state_dict()
+        m2 = IdMapper(8)
+        m2.load_state_dict(state)
+        assert np.array_equal(
+            m2.lookup(np.array([10, 20]), count=False),
+            m.lookup(np.array([10, 20]), count=False),
+        )
+        assert m2.frequencies(np.array([10]))[0] == 2
+
+
+class TestKvEmbedding:
+    def test_lookup_and_embed(self):
+        kv = KvEmbedding(dim=4, capacity=16)
+        table = kv.init_table(jax.random.key(0))
+        slots = kv.lookup_slots(np.array([[111, 222], [111, 333]]))
+        vecs = KvEmbedding.embed(table, slots)
+        assert vecs.shape == (2, 2, 4)
+        np.testing.assert_array_equal(
+            np.asarray(vecs[0, 0]), np.asarray(vecs[1, 0])
+        )
+
+    def test_gradient_flows_to_touched_rows_only(self):
+        kv = KvEmbedding(dim=4, capacity=16)
+        table = kv.init_table(jax.random.key(0))
+        slots = kv.lookup_slots(np.array([42, 43]))
+
+        def loss(tbl):
+            return jnp.sum(KvEmbedding.embed(tbl, slots) ** 2)
+
+        g = jax.grad(loss)(table)
+        touched = np.unique(slots)
+        mask = np.zeros(16, bool)
+        mask[touched] = True
+        g_np = np.asarray(g)
+        assert np.all(g_np[~mask] == 0)
+        assert np.all(np.any(g_np[mask] != 0, axis=1))
+
+    def test_export_import_roundtrip(self):
+        kv = KvEmbedding(dim=4, capacity=16)
+        table = kv.init_table(jax.random.key(0))
+        slots = kv.lookup_slots(np.array([7, 8, 7]))
+        ids, vecs, freqs = kv.export(table)
+        assert set(ids.tolist()) == {7, 8}
+        assert vecs.shape == (2, 4)
+
+        kv2 = KvEmbedding(dim=4, capacity=16)
+        table2 = kv2.init_table(jax.random.key(1))
+        table2 = kv2.import_(table2, ids, vecs, freqs)
+        # imported frequencies are preserved as-is
+        assert kv2.mapper.frequencies(np.array([7]))[0] == 2
+        slots2 = kv2.mapper.lookup(np.array([7, 8]), count=False)
+        got = np.asarray(KvEmbedding.embed(table2, slots2))
+        want_7 = vecs[list(ids).index(7)]
+        np.testing.assert_allclose(got[0], want_7, rtol=1e-6)
+        del slots
+
+    def test_export_min_frequency_filters(self):
+        kv = KvEmbedding(dim=2, capacity=8)
+        table = kv.init_table(jax.random.key(0))
+        kv.lookup_slots(np.array([1, 1, 1, 2]))
+        ids, _, _ = kv.export(table, min_frequency=2)
+        assert ids.tolist() == [1]
+
+    def test_evict_zeroes_rows(self):
+        kv = KvEmbedding(dim=2, capacity=8)
+        table = kv.init_table(jax.random.key(0))
+        slots = kv.lookup_slots(np.array([1, 1, 2]))
+        cold_slot = int(slots[2])
+        table = kv.evict(table, threshold=2)
+        assert np.all(np.asarray(table)[cold_slot] == 0)
+        assert len(kv.mapper) == 1
+
+
+class TestGroupAdam:
+    def _sparse_grad(self, rows=8, dim=4, touched=(1, 3)):
+        g = np.zeros((rows, dim), np.float32)
+        for r in touched:
+            g[r] = 1.0
+        return jnp.asarray(g)
+
+    def test_untouched_rows_have_zero_update_and_frozen_state(self):
+        params = {"t": jnp.ones((8, 4))}
+        opt = group_adam(1e-1)
+        state = opt.init(params)
+        g = {"t": self._sparse_grad()}
+        updates, state = opt.update(g, state, params)
+        u = np.asarray(updates["t"])
+        assert np.all(u[[0, 2, 4, 5, 6, 7]] == 0)
+        assert np.any(u[1] != 0) and np.any(u[3] != 0)
+        inner = state[0]
+        assert np.asarray(inner.steps["t"]).reshape(-1)[1] == 1
+        assert np.asarray(inner.steps["t"]).reshape(-1)[0] == 0
+
+    def test_rare_rows_get_fresh_bias_correction(self):
+        """A row touched for the first time at step 100 must get the same
+        update magnitude as a row touched at step 1 (per-row counts)."""
+        params = {"t": jnp.zeros((2, 4))}
+        opt = group_adam(1.0)
+        state = opt.init(params)
+        # touch row 0 a hundred times
+        for _ in range(100):
+            g = {"t": jnp.asarray(
+                np.array([[1, 1, 1, 1], [0, 0, 0, 0]], np.float32)
+            )}
+            updates, state = opt.update(g, state, params)
+        first_row0 = None
+        # now touch row 1 for the first time
+        g = {"t": jnp.asarray(
+            np.array([[0, 0, 0, 0], [1, 1, 1, 1]], np.float32)
+        )}
+        updates, state = opt.update(g, state, params)
+        u = np.asarray(updates["t"])
+        # fresh row's first update ~ -lr * 1.0 (full bias correction)
+        np.testing.assert_allclose(u[1], -1.0, rtol=1e-4)
+        del first_row0
+
+    def test_trains_embedding_end_to_end(self):
+        kv = KvEmbedding(dim=4, capacity=32)
+        params = {"table": kv.init_table(jax.random.key(0))}
+        opt = group_adam(5e-2)
+        state = opt.init(params)
+        target = jnp.ones((4,))
+        slots = kv.lookup_slots(np.array([9, 9, 12]))
+
+        @jax.jit
+        def step(params, state):
+            def loss(p):
+                vec = KvEmbedding.embed(p["table"], slots)
+                return jnp.mean((vec - target) ** 2)
+
+            l, g = jax.value_and_grad(loss)(params)
+            updates, state2 = opt.update(g, state, params)
+            return optax.apply_updates(params, updates), state2, l
+
+        for _ in range(200):
+            params, state, l = step(params, state)
+        assert float(l) < 1e-3
+
+
+class TestGroupAdagrad:
+    def test_masked_accumulation(self):
+        params = {"t": jnp.ones((4, 2))}
+        opt = group_adagrad(1e-1)
+        state = opt.init(params)
+        g = np.zeros((4, 2), np.float32)
+        g[2] = 3.0
+        updates, state = opt.update({"t": jnp.asarray(g)}, state, params)
+        u = np.asarray(updates["t"])
+        assert np.all(u[[0, 1, 3]] == 0)
+        assert np.all(u[2] != 0)
