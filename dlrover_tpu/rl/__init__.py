@@ -1,0 +1,26 @@
+from dlrover_tpu.rl.ppo_utils import (
+    gae_advantages_and_returns,
+    kl_penalty,
+    logprobs_from_logits,
+    ppo_loss,
+    rewards_with_kl,
+    whiten,
+)
+from dlrover_tpu.rl.replay_buffer import ReplayBuffer
+from dlrover_tpu.rl.model_engine import ModelEngine, ModelSpec
+from dlrover_tpu.rl.ppo_trainer import PPOConfig, PPOTrainer, RLTrainer
+
+__all__ = [
+    "gae_advantages_and_returns",
+    "kl_penalty",
+    "logprobs_from_logits",
+    "ppo_loss",
+    "rewards_with_kl",
+    "whiten",
+    "ReplayBuffer",
+    "ModelEngine",
+    "ModelSpec",
+    "PPOConfig",
+    "PPOTrainer",
+    "RLTrainer",
+]

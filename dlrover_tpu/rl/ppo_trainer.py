@@ -1,0 +1,218 @@
+"""RLTrainer / PPOTrainer: the experience -> update RLHF loop.
+
+Equivalent capability: reference atorch/atorch/rl/trainer/rl_trainer.py:7
+and ppo_trainer.py:4 (loop skeleton: make_experience over prompts, then
+rl_training over the replay buffer), with the PPO math from
+ppo_utils (reference ppo_util.py).
+
+TPU redesign: experience generation and the PPO update are two jitted
+programs; the whole inner update (actor + critic, microbatched over the
+replay buffer) runs on-device, and both models' parameter/optimizer
+pytrees shard over the mesh like any auto_accelerate state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.rl.model_engine import ModelEngine
+from dlrover_tpu.rl.ppo_utils import (
+    gae_advantages_and_returns,
+    logprobs_from_logits,
+    ppo_loss,
+    rewards_with_kl,
+)
+from dlrover_tpu.rl.replay_buffer import ReplayBuffer
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    kl_coef: float = 0.1
+    gamma: float = 1.0
+    lam: float = 0.95
+    clip_ratio: float = 0.2
+    value_clip: float = 0.2
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.0
+    ppo_epochs: int = 4
+    train_batch_size: int = 8
+    whiten_advantages: bool = True
+
+
+class RLTrainer:
+    """Loop skeleton (reference rl_trainer.py): subclasses implement
+    make_experience + rl_training; train() alternates them."""
+
+    def __init__(self, engine: ModelEngine, config):
+        self.engine = engine
+        self.config = config
+        self.buffer = ReplayBuffer()
+
+    def make_experience(self, prompts):
+        raise NotImplementedError
+
+    def rl_training(self):
+        raise NotImplementedError
+
+    def train(self, prompt_batches, iterations: int = 1):
+        stats = {}
+        for it in range(iterations):
+            for prompts in prompt_batches:
+                self.buffer.reset()
+                self.make_experience(prompts)
+                stats = self.rl_training()
+            logger.info("rl iteration %d: %s", it, {
+                k: round(float(v), 5) for k, v in stats.items()
+            })
+        return stats
+
+
+class PPOTrainer(RLTrainer):
+    """PPO over an actor/critic/ref(/reward) ModelEngine.
+
+    Model contracts (all [B, T] time-major batches):
+    - actor.apply(params, obs) -> logits [B, T, A]
+    - critic.apply(params, obs) -> values [B, T]
+    - reward: either a ModelEngine "reward" model mapping obs -> scalar
+      scores [B], or a ``score_fn(obs, actions)`` passed to
+      make_experience.
+    """
+
+    def __init__(self, engine: ModelEngine, config: PPOConfig,
+                 score_fn=None, rng_seed: int = 0):
+        super().__init__(engine, config)
+        self._score_fn = score_fn
+        self._rng = jax.random.key(rng_seed)
+        self._update = self._build_update()
+
+    # -------------------------------------------------------- experience
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def make_experience(self, prompts):
+        """Roll the actor over ``prompts`` (obs [B, T, ...]): sample
+        actions, score them, store (obs, actions, logprobs, values,
+        advantages, returns, mask). Advantages/returns (whitened over the
+        FULL rollout) are computed once here, not per microbatch in the
+        update loop."""
+        obs = jnp.asarray(prompts["obs"])
+        mask = jnp.asarray(prompts.get(
+            "mask", np.ones(obs.shape[:2], np.float32)
+        ))
+        logits = self.engine.apply("actor", obs)
+        actions = jax.random.categorical(self._next_rng(), logits)
+        logprobs = logprobs_from_logits(logits, actions)
+        ref_logits = self.engine.apply(
+            "ref", obs
+        ) if "ref" in self.engine.specs else logits
+        ref_logprobs = logprobs_from_logits(ref_logits, actions)
+        values = self.engine.apply("critic", obs)
+        if self._score_fn is not None:
+            scores = jnp.asarray(self._score_fn(obs, actions))
+        elif "reward" in self.engine.specs:
+            scores = self.engine.apply("reward", obs, actions)
+        else:
+            raise ValueError("need a reward model or score_fn")
+        rewards = rewards_with_kl(
+            scores, logprobs, ref_logprobs, mask, self.config.kl_coef
+        )
+        advantages, returns = gae_advantages_and_returns(
+            values, rewards, mask, self.config.gamma, self.config.lam,
+            self.config.whiten_advantages,
+        )
+        self.buffer.add_samples({
+            "obs": np.asarray(obs),
+            "actions": np.asarray(actions),
+            "old_logprobs": np.asarray(logprobs),
+            "old_values": np.asarray(values),
+            "advantages": np.asarray(advantages),
+            "returns": np.asarray(returns),
+            "mask": np.asarray(mask),
+        })
+        return float(jnp.mean(scores))
+
+    # ------------------------------------------------------------ update
+
+    def _build_update(self):
+        cfg = self.config
+        actor_spec = self.engine.specs["actor"]
+        critic_spec = self.engine.specs["critic"]
+        actor_tx = self.engine.optimizer("actor")
+        critic_tx = self.engine.optimizer("critic")
+
+        def loss_fn(actor_params, critic_params, batch):
+            logits = actor_spec.apply_fn(actor_params, batch["obs"])
+            values = critic_spec.apply_fn(critic_params, batch["obs"])
+            logprobs = logprobs_from_logits(logits, batch["actions"])
+            total, stats = ppo_loss(
+                logprobs, values,
+                batch["old_logprobs"], batch["old_values"],
+                batch["advantages"], batch["returns"], batch["mask"],
+                cfg.clip_ratio, cfg.value_clip, cfg.vf_coef,
+                cfg.entropy_coef, logits=logits,
+            )
+            return total, stats
+
+        @jax.jit
+        def update(actor_params, critic_params, actor_opt, critic_opt,
+                   batch):
+            grad_fn = jax.grad(loss_fn, argnums=(0, 1), has_aux=True)
+            (a_grads, c_grads), stats = grad_fn(
+                actor_params, critic_params, batch
+            )
+            a_updates, actor_opt = actor_tx.update(
+                a_grads, actor_opt, actor_params
+            )
+            actor_params = optax.apply_updates(actor_params, a_updates)
+            c_updates, critic_opt = critic_tx.update(
+                c_grads, critic_opt, critic_params
+            )
+            critic_params = optax.apply_updates(critic_params, c_updates)
+            return actor_params, critic_params, actor_opt, critic_opt, \
+                stats
+
+        return update
+
+    def rl_training(self):
+        cfg = self.config
+        stats = {}
+        batch_size = cfg.train_batch_size
+        if len(self.buffer) < batch_size:
+            if len(self.buffer) == 0:
+                logger.warning("rl_training with an empty buffer")
+                return stats
+            logger.warning(
+                "buffer has %d samples < train_batch_size %d; "
+                "shrinking the batch so the update still runs",
+                len(self.buffer), batch_size,
+            )
+            batch_size = len(self.buffer)
+        for epoch in range(cfg.ppo_epochs):
+            for batch in self.buffer.batches(
+                batch_size, seed=epoch
+            ):
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                (
+                    self.engine.params["actor"],
+                    self.engine.params["critic"],
+                    self.engine.opt_states["actor"],
+                    self.engine.opt_states["critic"],
+                    stats,
+                ) = self._update(
+                    self.engine.params["actor"],
+                    self.engine.params["critic"],
+                    self.engine.opt_states["actor"],
+                    self.engine.opt_states["critic"],
+                    batch,
+                )
+        return stats

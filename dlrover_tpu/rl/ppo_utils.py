@@ -1,0 +1,155 @@
+"""PPO math: logprobs, KL penalty, GAE, clipped losses.
+
+Equivalent capability: reference atorch/atorch/rl/ppo_utils/ppo_util.py —
+`get_kl_penalty` (:19), `get_rewards` (:55), `loss` (:79 — clipped policy
++ clipped value losses over response masks), `get_advantages_and_returns`
+(:147 — GAE with optional whitening).
+
+TPU-first: everything is pure jnp on [batch, time] tensors — the whole
+PPO update jits into one XLA program; GAE's backward recursion uses
+``lax.scan`` (reversed) instead of a Python loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def logprobs_from_logits(logits, actions):
+    """log pi(a_t | s_t) for the taken actions: [B, T].
+
+    Reuses the fused fp32 logsumexp-minus-gather CE kernel (negated):
+    no full log-softmax tensor, and bf16 logits don't leak precision
+    into the PPO importance ratios."""
+    from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+
+    loss, _valid = softmax_cross_entropy(logits, actions)
+    return -loss
+
+
+def kl_penalty(logprobs, ref_logprobs, kl_coef: float):
+    """Per-token KL penalty against the frozen reference policy
+    (reference get_kl_penalty — the k1 estimator logp - ref_logp)."""
+    return -kl_coef * (logprobs - ref_logprobs)
+
+
+def rewards_with_kl(scores, logprobs, ref_logprobs, mask,
+                    kl_coef: float = 0.1):
+    """Dense per-token reward = KL penalty everywhere + the scalar score
+    on the last valid token (reference get_rewards :55)."""
+    rewards = kl_penalty(logprobs, ref_logprobs, kl_coef) * mask
+    last = (
+        jnp.maximum(jnp.sum(mask, axis=-1) - 1, 0).astype(jnp.int32)
+    )
+    batch_idx = jnp.arange(rewards.shape[0])
+    rewards = rewards.at[batch_idx, last].add(scores)
+    return rewards
+
+
+def whiten(x, mask=None, eps: float = 1e-8):
+    """Zero-mean unit-variance (masked), keeping the mean shift out of
+    the gradient like the reference's whitening."""
+    if mask is None:
+        mean, var = jnp.mean(x), jnp.var(x)
+    else:
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        mean = jnp.sum(x * mask) / denom
+        var = jnp.sum(((x - mean) ** 2) * mask) / denom
+    return (x - mean) * jax.lax.rsqrt(var + eps)
+
+
+def gae_advantages_and_returns(values, rewards, mask, gamma: float = 1.0,
+                               lam: float = 0.95,
+                               use_whitening: bool = True):
+    """Generalized advantage estimation over the time axis.
+
+    ``values``/``rewards``/``mask``: [B, T]. Returns (advantages,
+    returns), both [B, T] (reference get_advantages_and_returns :147).
+    The backward recursion is a reversed ``lax.scan`` — one fused kernel,
+    no per-step host control flow.
+    """
+    T = values.shape[-1]
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros_like(values[:, :1])], axis=-1
+    )
+    # gate the bootstrap with the NEXT position's mask: the last valid
+    # token must not bootstrap from the critic's value of padding
+    next_mask = jnp.concatenate(
+        [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=-1
+    )
+    deltas = (rewards + gamma * next_values * next_mask - values) * mask
+
+    def body(carry, xs):
+        delta_t, mask_t = xs
+        carry = delta_t + gamma * lam * carry * mask_t
+        return carry, carry
+
+    _, adv_rev = jax.lax.scan(
+        body,
+        jnp.zeros(values.shape[0]),
+        (deltas.T[::-1], mask.T[::-1]),
+    )
+    advantages = adv_rev[::-1].T
+    returns = advantages + values
+    if use_whitening:
+        advantages = whiten(advantages, mask)
+    del T
+    return jax.lax.stop_gradient(advantages), jax.lax.stop_gradient(
+        returns
+    )
+
+
+def ppo_loss(
+    logprobs,
+    values,
+    old_logprobs,
+    old_values,
+    advantages,
+    returns,
+    mask,
+    clip_ratio: float = 0.2,
+    value_clip: float = 0.2,
+    vf_coef: float = 0.5,
+    entropy_coef: float = 0.0,
+    logits=None,
+):
+    """Clipped PPO policy + value loss (reference loss :79).
+
+    Returns (total_loss, stats_dict)."""
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    ratio = jnp.exp(logprobs - old_logprobs)
+    pg1 = -advantages * ratio
+    pg2 = -advantages * jnp.clip(
+        ratio, 1.0 - clip_ratio, 1.0 + clip_ratio
+    )
+    pg_loss = jnp.sum(jnp.maximum(pg1, pg2) * mask) / denom
+
+    v_clipped = old_values + jnp.clip(
+        values - old_values, -value_clip, value_clip
+    )
+    vf1 = (values - returns) ** 2
+    vf2 = (v_clipped - returns) ** 2
+    vf_loss = 0.5 * jnp.sum(jnp.maximum(vf1, vf2) * mask) / denom
+
+    entropy = jnp.zeros(())
+    if logits is not None and entropy_coef:
+        p = jax.nn.softmax(logits, axis=-1)
+        ent_t = -jnp.sum(
+            p * jax.nn.log_softmax(logits, axis=-1), axis=-1
+        )
+        entropy = jnp.sum(ent_t * mask) / denom
+
+    total = pg_loss + vf_coef * vf_loss - entropy_coef * entropy
+    stats = {
+        "policy_loss": pg_loss,
+        "value_loss": vf_loss,
+        "entropy": entropy,
+        "approx_kl": jnp.sum(
+            (old_logprobs - logprobs) * mask
+        ) / denom,
+        "clip_frac": jnp.sum(
+            (jnp.abs(ratio - 1.0) > clip_ratio) * mask
+        ) / denom,
+    }
+    return total, stats

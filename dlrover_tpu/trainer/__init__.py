@@ -43,3 +43,13 @@ def local_rank() -> int:
 
 def node_rank() -> int:
     return int(os.environ.get(NodeEnv.NODE_RANK, "0"))
+
+
+def __getattr__(name):
+    # lazy: Trainer pulls in jax/optax/parallel machinery; keep bare
+    # `import dlrover_tpu.trainer` cheap for the agent process
+    if name in ("Trainer", "TrainingArgs"):
+        from dlrover_tpu.trainer import trainer as _t
+
+        return getattr(_t, name)
+    raise AttributeError(name)
