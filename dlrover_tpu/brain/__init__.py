@@ -1,0 +1,16 @@
+from dlrover_tpu.brain.datastore import MetricsStore
+from dlrover_tpu.brain.service import BrainService, create_brain_service
+from dlrover_tpu.brain.client import (
+    BrainClient,
+    BrainReporter,
+    BrainResourceOptimizer,
+)
+
+__all__ = [
+    "MetricsStore",
+    "BrainService",
+    "create_brain_service",
+    "BrainClient",
+    "BrainReporter",
+    "BrainResourceOptimizer",
+]

@@ -1,0 +1,117 @@
+"""Pluggable brain optimization algorithms.
+
+Equivalent capability: reference dlrover/go/brain/pkg/optimizer/
+implementation/optalgorithm/*.go — PS cold create, init-adjust, OOM,
+worker create/running resource. Each algorithm is a function
+``(store, request) -> plan dict | None`` registered by name; the TPU
+set covers SPMD worker jobs:
+
+- ``cold_create``: size a brand-new job from similar historical jobs
+  (median of their last-known worker_count / memory).
+- ``worker_resource``: running-job memory right-sizing from this job's
+  own usage records (peak * headroom).
+- ``oom_memory``: multiply memory after an OOM event.
+- ``worker_count``: pick the historical worker count with the best
+  per-worker throughput for this job name.
+"""
+
+from __future__ import annotations
+
+import statistics
+
+from dlrover_tpu.brain.datastore import MetricsStore
+from dlrover_tpu.brain.messages import OptimizeRequest
+
+_ALGORITHMS: dict = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _ALGORITHMS[name] = fn
+        return fn
+
+    return deco
+
+
+def get_algorithm(name: str):
+    return _ALGORITHMS.get(name)
+
+
+def algorithm_names() -> list[str]:
+    return sorted(_ALGORITHMS)
+
+
+@register("cold_create")
+def optimize_cold_create(store: MetricsStore, req: OptimizeRequest):
+    histories = store.similar_job_records(req.job_name)
+    counts, mems = [], []
+    for records in histories:
+        if not records:
+            continue
+        latest = records[0]
+        if latest.get("worker_count"):
+            counts.append(int(latest["worker_count"]))
+        if latest.get("used_memory_mb"):
+            mems.append(float(latest["used_memory_mb"]))
+    if not counts and not mems:
+        return None
+    plan = {}
+    if counts:
+        plan["worker_count"] = int(statistics.median(counts))
+    if mems:
+        plan["memory_mb"] = int(statistics.median(mems) * 1.3)
+    return plan
+
+
+@register("worker_resource")
+def optimize_worker_resource(store: MetricsStore, req: OptimizeRequest):
+    records = store.job_records(req.job_uuid, limit=100)
+    mems = [
+        float(r["used_memory_mb"]) for r in records
+        if r.get("used_memory_mb")
+    ]
+    if not mems:
+        return None
+    peak = max(mems)
+    headroom = float(req.config.get("headroom", 1.4))
+    return {"memory_mb": int(peak * headroom)}
+
+
+@register("oom_memory")
+def optimize_oom_memory(store: MetricsStore, req: OptimizeRequest):
+    current = float(req.config.get("memory_mb", 0))
+    if current <= 0:
+        records = store.job_records(req.job_uuid, limit=10)
+        mems = [
+            float(r["used_memory_mb"]) for r in records
+            if r.get("used_memory_mb")
+        ]
+        if not mems:
+            return None
+        current = max(mems)
+    factor = float(req.config.get("factor", 2.0))
+    return {"memory_mb": int(current * factor)}
+
+
+@register("worker_count")
+def optimize_worker_count(store: MetricsStore, req: OptimizeRequest):
+    """Best per-worker throughput across this job's history (and similar
+    jobs when the current one has no samples)."""
+    records = store.job_records(req.job_uuid, limit=500)
+    if not records:
+        records = [
+            r for recs in store.similar_job_records(req.job_name)
+            for r in recs
+        ]
+    by_count: dict[int, list[float]] = {}
+    for r in records:
+        count, speed = r.get("worker_count"), r.get("speed")
+        if count and speed:
+            by_count.setdefault(int(count), []).append(float(speed))
+    if not by_count:
+        return None
+    best = max(
+        by_count.items(),
+        key=lambda kv: statistics.mean(kv[1]),
+    )
+    return {"worker_count": best[0]}

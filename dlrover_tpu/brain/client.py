@@ -1,0 +1,166 @@
+"""Brain client + master-side integrations.
+
+Equivalent capability: reference dlrover/python/brain/client.py:63
+(gRPC brain client) plus the master pieces that talk to it —
+`BrainReporter` (stats/reporter.py:146 — periodic job metrics push) and
+`BrainResoureOptimizer` (resource/brain_optimizer.py:64 — ResourcePlans
+from the brain service).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dlrover_tpu.brain import messages as bmsg
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.common.rpc import RpcClient
+from dlrover_tpu.master.resource import ResourceOptimizer, ResourcePlan
+
+logger = get_logger(__name__)
+
+
+class BrainClient:
+    def __init__(self, addr: str):
+        self._rpc = RpcClient(addr)
+
+    def persist_metrics(self, job_uuid: str, job_name: str,
+                        metrics: dict) -> bool:
+        return self._rpc.report(
+            "brain-client", 0,
+            bmsg.PersistMetricsRequest(
+                job_uuid=job_uuid, job_name=job_name,
+                timestamp=time.time(), metrics=metrics,
+            ),
+        )
+
+    def optimize(self, job_uuid: str, job_name: str, opt_type: str,
+                 config: dict | None = None) -> dict | None:
+        resp = self._rpc.get(
+            "brain-client", 0,
+            bmsg.OptimizeRequest(
+                job_uuid=job_uuid, job_name=job_name,
+                opt_type=opt_type, config=config or {},
+            ),
+        )
+        if isinstance(resp, bmsg.OptimizeResponse) and resp.found:
+            return resp.plan
+        return None
+
+    def get_job_metrics(self, job_uuid: str) -> list:
+        resp = self._rpc.get(
+            "brain-client", 0,
+            bmsg.GetJobMetricsRequest(job_uuid=job_uuid),
+        )
+        if isinstance(resp, bmsg.JobMetricsResponse):
+            return resp.records
+        return []
+
+    def close(self):
+        self._rpc.close()
+
+
+class BrainResourceOptimizer(ResourceOptimizer):
+    """ResourceOptimizer delegating sizing decisions to the brain."""
+
+    def __init__(self, client: BrainClient, job_uuid: str, job_name: str):
+        self._client = client
+        self._job_uuid = job_uuid
+        self._job_name = job_name
+
+    def _plan_from(self, plan_dict: dict | None) -> ResourcePlan:
+        plan = ResourcePlan()
+        if not plan_dict:
+            return plan
+        group = NodeGroupResource(
+            int(plan_dict.get("worker_count", 0)),
+            NodeResource(
+                cpu=float(plan_dict.get("cpu", 0)),
+                memory=int(plan_dict.get("memory_mb", 0)),
+            ),
+        )
+        if group.count or group.node_resource.memory:
+            plan.node_group_resources[NodeType.WORKER] = group
+        return plan
+
+    def generate_opt_plan(self, phase: str, config: dict) -> ResourcePlan:
+        opt_type = "cold_create" if phase == "initial" else "worker_count"
+        return self._plan_from(self._client.optimize(
+            self._job_uuid, self._job_name, opt_type, config
+        ))
+
+    def generate_oom_recovery_plan(self, oom_nodes: list,
+                                   phase: str) -> ResourcePlan:
+        plan = ResourcePlan()
+        for node in oom_nodes:
+            got = self._client.optimize(
+                self._job_uuid, self._job_name, "oom_memory",
+                {"memory_mb": getattr(
+                    node.config_resource, "memory", 0
+                )},
+            )
+            if got and got.get("memory_mb"):
+                plan.node_resources[node.name] = NodeResource(
+                    memory=int(got["memory_mb"])
+                )
+        return plan
+
+
+class BrainReporter:
+    """Periodically pushes job runtime metrics to the brain (reference
+    BrainReporter stats/reporter.py:146)."""
+
+    def __init__(self, client: BrainClient, job_uuid: str, job_name: str,
+                 job_manager=None, speed_monitor=None,
+                 interval: float = 60.0):
+        self._client = client
+        self._job_uuid = job_uuid
+        self._job_name = job_name
+        self._job_manager = job_manager
+        self._speed_monitor = speed_monitor
+        self._interval = interval
+        self._stopped = threading.Event()
+
+    def collect_metrics(self) -> dict:
+        metrics: dict = {"status": "running"}
+        if self._speed_monitor is not None:
+            metrics["speed"] = self._speed_monitor.running_speed
+            metrics["global_step"] = (
+                self._speed_monitor.completed_global_step
+            )
+        if self._job_manager is not None:
+            nodes = self._job_manager.get_job_nodes(NodeType.WORKER)
+            alive = [
+                n for n in nodes.values() if not n.is_released
+            ]
+            metrics["worker_count"] = len(alive)
+            mems = [
+                n.used_resource.memory for n in alive
+                if n.used_resource.memory
+            ]
+            if mems:
+                metrics["used_memory_mb"] = max(mems)
+        return metrics
+
+    def report_once(self) -> bool:
+        return self._client.persist_metrics(
+            self._job_uuid, self._job_name, self.collect_metrics()
+        )
+
+    def start(self):
+        threading.Thread(
+            target=self._loop, name="brain-reporter", daemon=True
+        ).start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                self.report_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("brain report failed")
+            self._stopped.wait(self._interval)

@@ -1,0 +1,94 @@
+"""Worker-side hang detection.
+
+Equivalent capability: reference atorch/atorch/fault_tolerance/
+hanging_detector.py:86 (`HangingDetector` — training processes report
+progress to a store; a monitor decides a relaunch when progress stalls)
+and custom_agent.py:19 (local agent acting on the decision).
+
+TPU notes: a hang usually means a stuck collective (ICI/DCN partner
+died) or a host-side deadlock — the Python thread here still runs, so a
+progress-timestamp watchdog works. The detector reports to the master
+(global hang handling: the master's SpeedMonitor + all_running_node_
+hanged covers the job level); locally it can run a callback (e.g.
+os._exit to trigger the agent's restart-with-rendezvous path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class HangingDetector:
+    def __init__(
+        self,
+        timeout: float = 600.0,
+        check_interval: float = 15.0,
+        on_hang: Optional[Callable[[], None]] = None,
+        master_client=None,
+    ):
+        self._timeout = timeout
+        self._interval = check_interval
+        self._on_hang = on_hang
+        self._client = master_client
+        self._last_progress = time.time()
+        self._last_step = -1
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._hang_reported = False
+
+    # ------------------------------------------------------------ report
+
+    def report_progress(self, step: int | None = None):
+        """Call from the training loop every step (cheap)."""
+        if step is not None:
+            if step == self._last_step:
+                return
+            self._last_step = step
+        self._last_progress = time.time()
+        self._hang_reported = False
+
+    def is_hanging(self) -> bool:
+        return time.time() - self._last_progress > self._timeout
+
+    # ----------------------------------------------------------- monitor
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="hang-detector", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                if self.is_hanging() and not self._hang_reported:
+                    self._hang_reported = True
+                    stalled = time.time() - self._last_progress
+                    logger.error(
+                        "no training progress for %.0fs (step %s): "
+                        "hang suspected", stalled, self._last_step,
+                    )
+                    if self._client is not None:
+                        try:
+                            self._client.report_failure(
+                                "hang: no progress for "
+                                f"{stalled:.0f}s", level="process_error",
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
+                    if self._on_hang is not None:
+                        self._on_hang()
+            except Exception:  # noqa: BLE001
+                logger.exception("hang detector iteration failed")
+            self._stopped.wait(self._interval)

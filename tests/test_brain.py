@@ -1,0 +1,143 @@
+"""Tests for the brain service (datastore, algorithms, service+client
+over real RPC, master integration) — reference coverage analogue: the
+Go brain's table-driven optalgorithm tests.
+"""
+
+import pytest
+
+from dlrover_tpu.brain import (
+    BrainClient,
+    BrainReporter,
+    BrainResourceOptimizer,
+    MetricsStore,
+    create_brain_service,
+)
+from dlrover_tpu.brain.algorithms import algorithm_names
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.node import Node, NodeResource
+
+
+@pytest.fixture
+def brain():
+    server, service = create_brain_service(0)
+    server.start()
+    client = BrainClient(f"127.0.0.1:{server.port}")
+    yield client, service
+    client.close()
+    server.stop()
+    service.store.close()
+
+
+class TestDatastore:
+    def test_persist_and_query(self):
+        store = MetricsStore()
+        store.persist("u1", "train-llama", {"speed": 5.0})
+        store.persist("u1", "train-llama", {"speed": 6.0})
+        records = store.job_records("u1")
+        assert len(records) == 2
+        assert records[0]["speed"] in (5.0, 6.0)
+
+    def test_similar_jobs(self):
+        store = MetricsStore()
+        for uuid in ("a", "b", "c"):
+            store.persist(uuid, "train-llama", {"worker_count": 4})
+        store.persist("x", "other-job", {"worker_count": 99})
+        histories = store.similar_job_records("train-llama")
+        assert len(histories) == 3
+        assert all(
+            h[0]["worker_count"] == 4 for h in histories
+        )
+
+
+class TestAlgorithms:
+    def test_registry(self):
+        assert {"cold_create", "worker_resource", "oom_memory",
+                "worker_count"} <= set(algorithm_names())
+
+    def test_cold_create_from_history(self, brain):
+        client, service = brain
+        for uuid, count, mem in (("a", 4, 1000), ("b", 8, 2000),
+                                 ("c", 6, 1500)):
+            service.store.persist(
+                uuid, "train-llama",
+                {"worker_count": count, "used_memory_mb": mem},
+            )
+        plan = client.optimize("new", "train-llama", "cold_create")
+        assert plan["worker_count"] == 6  # median
+        assert plan["memory_mb"] == int(1500 * 1.3)
+
+    def test_cold_create_no_history(self, brain):
+        client, _ = brain
+        assert client.optimize("new", "never-seen", "cold_create") is None
+
+    def test_worker_resource_headroom(self, brain):
+        client, service = brain
+        for mem in (1000, 3000, 2000):
+            service.store.persist(
+                "job1", "j", {"used_memory_mb": mem}
+            )
+        plan = client.optimize("job1", "j", "worker_resource")
+        assert plan["memory_mb"] == int(3000 * 1.4)
+
+    def test_oom_memory(self, brain):
+        client, _ = brain
+        plan = client.optimize(
+            "j", "j", "oom_memory", {"memory_mb": 4096}
+        )
+        assert plan["memory_mb"] == 8192
+
+    def test_worker_count_best_throughput(self, brain):
+        client, service = brain
+        samples = [(4, 40.0), (8, 60.0), (16, 64.0), (8, 62.0)]
+        for count, speed in samples:
+            service.store.persist(
+                "job2", "j2", {"worker_count": count, "speed": speed}
+            )
+        plan = client.optimize("job2", "j2", "worker_count")
+        # 16 workers had the highest mean aggregate speed
+        assert plan["worker_count"] == 16
+
+    def test_unknown_opt_type(self, brain):
+        client, _ = brain
+        assert client.optimize("j", "j", "nope") is None
+
+
+class TestServiceRoundtrip:
+    def test_persist_and_get_metrics_over_rpc(self, brain):
+        client, _ = brain
+        assert client.persist_metrics("u9", "jobx", {"speed": 3.0})
+        records = client.get_job_metrics("u9")
+        assert len(records) == 1
+        assert records[0]["speed"] == 3.0
+
+
+class TestMasterIntegration:
+    def test_brain_resource_optimizer(self, brain):
+        client, service = brain
+        for uuid, count in (("a", 4), ("b", 4)):
+            service.store.persist(
+                uuid, "train-x", {"worker_count": 4,
+                                  "used_memory_mb": 1000}
+            )
+        opt = BrainResourceOptimizer(client, "new-job", "train-x")
+        plan = opt.generate_opt_plan("initial", {})
+        group = plan.node_group_resources[NodeType.WORKER]
+        assert group.count == 4
+
+        node = Node(NodeType.WORKER, 0,
+                    config_resource=NodeResource(memory=2048))
+        node.name = "worker-0"
+        oom_plan = opt.generate_oom_recovery_plan([node], "stable")
+        assert oom_plan.node_resources["worker-0"].memory == 4096
+
+    def test_brain_reporter(self, brain, local_master):
+        client, service = brain
+        reporter = BrainReporter(
+            client, "job-r", "reporter-job",
+            job_manager=local_master.job_manager,
+            speed_monitor=local_master.task_manager.speed_monitor,
+        )
+        assert reporter.report_once()
+        records = client.get_job_metrics("job-r")
+        assert records and records[0]["status"] == "running"
+        assert "worker_count" in records[0]
