@@ -212,6 +212,16 @@ class MasterClient:
 
     # -------------------------------------------------------------- config
 
+    def get_ps_version(self, version_type: str = "global") -> int:
+        resp = self._get(msg.PsVersionRequest(version_type=version_type))
+        return resp.version if resp is not None else 0
+
+    def report_ps_version(self, version: int,
+                          version_type: str = "local") -> bool:
+        return self._report(msg.PsVersionReport(
+            version_type=version_type, version=version
+        ))
+
     def get_paral_config(self) -> msg.ParallelConfig:
         return self._get(msg.ParallelConfigRequest())
 

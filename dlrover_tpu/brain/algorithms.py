@@ -11,8 +11,9 @@ set covers SPMD worker jobs:
 - ``worker_resource``: running-job memory right-sizing from this job's
   own usage records (peak * headroom).
 - ``oom_memory``: multiply memory after an OOM event.
-- ``worker_count``: pick the historical worker count with the best
-  per-worker throughput for this job name.
+- ``worker_count``: the largest historical worker count that still
+  scales efficiently (per-worker throughput above a floor relative to
+  the smallest measured count).
 """
 
 from __future__ import annotations
@@ -94,9 +95,16 @@ def optimize_oom_memory(store: MetricsStore, req: OptimizeRequest):
 
 
 @register("worker_count")
-def optimize_worker_count(store: MetricsStore, req: OptimizeRequest):
-    """Best per-worker throughput across this job's history (and similar
-    jobs when the current one has no samples)."""
+def optimize_worker_count(store: MetricsStore, req: OptimizeRequest,
+                          min_efficiency: float = 0.7):
+    """Largest historical worker count that still scales efficiently.
+
+    Picking max aggregate speed would always choose the biggest count
+    ever tried; picking max per-worker speed always chooses the
+    smallest. The useful answer is the largest count whose per-worker
+    throughput stays >= ``min_efficiency`` of the per-worker throughput
+    at the smallest measured count (configurable via
+    ``config["min_efficiency"]``)."""
     records = store.job_records(req.job_uuid, limit=500)
     if not records:
         records = [
@@ -110,8 +118,16 @@ def optimize_worker_count(store: MetricsStore, req: OptimizeRequest):
             by_count.setdefault(int(count), []).append(float(speed))
     if not by_count:
         return None
-    best = max(
-        by_count.items(),
-        key=lambda kv: statistics.mean(kv[1]),
-    )
-    return {"worker_count": best[0]}
+    min_eff = float(req.config.get("min_efficiency", min_efficiency))
+    per_worker = {
+        c: statistics.mean(speeds) / c for c, speeds in by_count.items()
+    }
+    base = per_worker[min(per_worker)]
+    if base <= 0:
+        return None
+    efficient = [
+        c for c, pw in per_worker.items() if pw >= min_eff * base
+    ]
+    if not efficient:
+        return None
+    return {"worker_count": max(efficient)}

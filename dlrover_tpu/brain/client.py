@@ -122,6 +122,7 @@ class BrainReporter:
         self._speed_monitor = speed_monitor
         self._interval = interval
         self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
 
     def collect_metrics(self) -> dict:
         metrics: dict = {"status": "running"}
@@ -150,9 +151,12 @@ class BrainReporter:
         )
 
     def start(self):
-        threading.Thread(
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
             target=self._loop, name="brain-reporter", daemon=True
-        ).start()
+        )
+        self._thread.start()
 
     def stop(self):
         self._stopped.set()

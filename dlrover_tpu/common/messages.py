@@ -320,6 +320,23 @@ class ParallelConfig(Message):
     strategy: str = ""
 
 
+@dataclass
+class PsVersionRequest(Message):
+    # "global" | "local" | "restored" (master ElasticPsService)
+    version_type: str = "global"
+
+
+@dataclass
+class PsVersionResponse(Message):
+    version: int = 0
+
+
+@dataclass
+class PsVersionReport(Message):
+    version_type: str = "local"
+    version: int = 0
+
+
 # --------------------------------------------------------------------------
 # checkpoint coordination
 # --------------------------------------------------------------------------

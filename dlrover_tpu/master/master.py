@@ -21,6 +21,7 @@ from dlrover_tpu.master.job_manager import (
     DistributedJobManager,
     LocalJobManager,
 )
+from dlrover_tpu.master.elastic_ps import ElasticPsService
 from dlrover_tpu.master.kvstore import KVStoreService, SyncService
 from dlrover_tpu.master.rendezvous import (
     ElasticTrainingRendezvousManager,
@@ -62,6 +63,7 @@ class LocalJobMaster(JobMaster):
         }
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
+        self.elastic_ps_service = ElasticPsService()
         self._server, self.servicer = create_master_service(
             port,
             task_manager=self.task_manager,
@@ -69,6 +71,7 @@ class LocalJobMaster(JobMaster):
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
             sync_service=self.sync_service,
+            elastic_ps_service=self.elastic_ps_service,
         )
 
     @property
@@ -147,6 +150,7 @@ class DistributedJobMaster(JobMaster):
         }
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
+        self.elastic_ps_service = ElasticPsService()
         self._server, self.servicer = create_master_service(
             port,
             task_manager=self.task_manager,
@@ -154,6 +158,7 @@ class DistributedJobMaster(JobMaster):
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
             sync_service=self.sync_service,
+            elastic_ps_service=self.elastic_ps_service,
         )
         # Dead nodes must leave rendezvous waiting sets and give their
         # in-flight shards back (code-review finding: these existed but

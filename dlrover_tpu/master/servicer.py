@@ -95,6 +95,7 @@ class MasterServicer(RpcService):
         self.kv_store = kv_store
         self.sync_service = sync_service
         self.job_metric_collector = job_metric_collector
+        self.elastic_ps_service = elastic_ps_service
         self.ckpt_barrier = CheckpointBarrierService()
         self._start_training_time = 0.0
         self._job_ended = threading.Event()
@@ -104,6 +105,14 @@ class MasterServicer(RpcService):
     # ------------------------------------------------------------------ get
 
     def get(self, node_type: str, node_id: int, message):
+        if isinstance(message, msg.PsVersionRequest):
+            if self.elastic_ps_service is None:
+                return msg.PsVersionResponse()
+            return msg.PsVersionResponse(
+                version=self.elastic_ps_service.get_ps_version(
+                    message.version_type, node_id
+                )
+            )
         if isinstance(message, msg.TaskRequest):
             return self._get_task(node_type, node_id, message)
         if isinstance(message, msg.ShardCheckpointRequest):
@@ -168,6 +177,13 @@ class MasterServicer(RpcService):
     # --------------------------------------------------------------- report
 
     def report(self, node_type: str, node_id: int, message) -> bool:
+        if isinstance(message, msg.PsVersionReport):
+            if self.elastic_ps_service is None:
+                return False
+            self.elastic_ps_service.update_ps_version(
+                node_id, message.version_type, message.version
+            )
+            return True
         if isinstance(message, msg.DatasetShardParams):
             self.task_manager.new_dataset(
                 batch_size=message.batch_size,

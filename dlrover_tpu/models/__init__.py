@@ -16,3 +16,12 @@ from dlrover_tpu.models.llama import (  # noqa: F401
     llama_loss_fn,
     PRESETS,
 )
+
+from dlrover_tpu.models.gpt2 import (  # noqa: F401
+    GPT2Config,
+    GPT2_PRESETS,
+    gpt2_logical_axes,
+    gpt2_init,
+    gpt2_apply,
+    gpt2_loss_fn,
+)

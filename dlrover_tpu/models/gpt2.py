@@ -1,0 +1,215 @@
+"""GPT-2 model family: TPU-first functional decoder.
+
+Equivalent capability: the reference accelerates HF GPT-2 via attention
+swaps (atorch/atorch/modules/transformer/layers.py:1570 `GPT2AttentionFA`)
+and module replacement. TPU redesign: a native functional implementation
+— learned positional embeddings, pre-LayerNorm blocks, gelu MLP, tied or
+untied LM head — with scan-over-layers stacking and the same logical
+sharding axes contract as the llama family, so every strategy
+(dp/fsdp/tp/sp/pp) applies unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+from dlrover_tpu.parallel.sharding import shard_logical
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 1024
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = False
+    # attention dispatch shared with the llama family: "flash" (Pallas),
+    # "reference" (tiny CPU shapes), "ulysses" (when seq axis active)
+    attn_impl: str = "flash"
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+    tie_lm_head: bool = True
+    # 0 = auto (pipeline_apply picks 2*stages); same contract as llama
+    pipe_microbatches: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def param_count(self) -> int:
+        d, m, L, v = self.dim, self.mlp_dim, self.n_layers, self.vocab_size
+        per_layer = 4 * d * d + 2 * d * m + 9 * d + m
+        head = 0 if self.tie_lm_head else d * v
+        return v * d + self.max_seq_len * d + L * per_layer + 2 * d + head
+
+
+GPT2_PRESETS = {
+    "tiny": GPT2Config(vocab_size=512, dim=128, n_layers=2, n_heads=4,
+                       mlp_dim=512, max_seq_len=256,
+                       attn_impl="reference"),
+    "gpt2-124m": GPT2Config(),
+    "gpt2-1.5b": GPT2Config(dim=1600, n_layers=48, n_heads=25,
+                            mlp_dim=6400),
+}
+
+
+def gpt2_init(config: GPT2Config, rng) -> dict:
+    d, m, L = config.dim, config.mlp_dim, config.n_layers
+    keys = jax.random.split(rng, 8)
+
+    def winit(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * (
+            fan_in ** -0.5
+        )
+
+    params = {
+        "embed": jax.random.normal(keys[0], (config.vocab_size, d)) * 0.02,
+        "pos_embed": jax.random.normal(
+            keys[1], (config.max_seq_len, d)
+        ) * 0.01,
+        "layers": {
+            "ln1_scale": jnp.ones((L, d)),
+            "ln1_bias": jnp.zeros((L, d)),
+            "w_qkv": winit(keys[2], (L, d, 3 * d), d),
+            "b_qkv": jnp.zeros((L, 3 * d)),
+            "w_proj": winit(keys[3], (L, d, d), d),
+            "b_proj": jnp.zeros((L, d)),
+            "ln2_scale": jnp.ones((L, d)),
+            "ln2_bias": jnp.zeros((L, d)),
+            "w_fc": winit(keys[4], (L, d, m), d),
+            "b_fc": jnp.zeros((L, m)),
+            "w_out": winit(keys[5], (L, m, d), m),
+            "b_out": jnp.zeros((L, d)),
+        },
+        "final_ln_scale": jnp.ones((d,)),
+        "final_ln_bias": jnp.zeros((d,)),
+    }
+    if not config.tie_lm_head:
+        params["lm_head"] = jax.random.normal(
+            keys[6], (d, config.vocab_size)
+        ) * 0.02
+    return params
+
+
+def gpt2_logical_axes(config: GPT2Config) -> dict:
+    axes = {
+        "embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "layers": {
+            "ln1_scale": ("layer", "embed"),
+            "ln1_bias": ("layer", "embed"),
+            "w_qkv": ("layer", "embed", "heads"),
+            "b_qkv": ("layer", "heads"),
+            "w_proj": ("layer", "heads", "embed"),
+            "b_proj": ("layer", "embed"),
+            "ln2_scale": ("layer", "embed"),
+            "ln2_bias": ("layer", "embed"),
+            "w_fc": ("layer", "embed", "mlp"),
+            "b_fc": ("layer", "mlp"),
+            "w_out": ("layer", "mlp", "embed"),
+            "b_out": ("layer", "embed"),
+        },
+        "final_ln_scale": ("embed",),
+        "final_ln_bias": ("embed",),
+    }
+    if not config.tie_lm_head:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _layer_norm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale + bias).astype(x.dtype)
+
+
+def _block(config: GPT2Config, x, p):
+    B, S, D = x.shape
+    h, hd = config.n_heads, config.head_dim
+    dtype = x.dtype
+
+    y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], config.norm_eps)
+    qkv = y @ p["w_qkv"].astype(dtype) + p["b_qkv"].astype(dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, h, hd)
+    k = k.reshape(B, S, h, hd)
+    v = v.reshape(B, S, h, hd)
+    # shared attention dispatcher (llama family): flash Pallas kernel,
+    # reference softmax, or ring/Ulysses when the seq mesh axis is active
+    from dlrover_tpu.models.llama import _attention
+
+    attn = _attention(config, q, k, v).reshape(B, S, D)
+    x = x + attn @ p["w_proj"].astype(dtype) + p["b_proj"].astype(dtype)
+    x = shard_logical(x, ("batch", "seq", "embed"))
+
+    y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], config.norm_eps)
+    hmid = jax.nn.gelu(
+        y @ p["w_fc"].astype(dtype) + p["b_fc"].astype(dtype)
+    )
+    hmid = shard_logical(hmid, ("batch", "seq", "mlp"))
+    x = x + hmid @ p["w_out"].astype(dtype) + p["b_out"].astype(dtype)
+    return shard_logical(x, ("batch", "seq", "embed"))
+
+
+def gpt2_apply(config: GPT2Config, params, tokens, positions=None):
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+    dtype = jnp.dtype(config.dtype)
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32), (B, S)
+        )
+    x = params["embed"].astype(dtype)[tokens]
+    x = x + params["pos_embed"].astype(dtype)[positions]
+    x = shard_logical(x, ("batch", "seq", "embed"))
+
+    from dlrover_tpu.parallel.pipeline import (
+        pipe_size,
+        pipeline_apply,
+        stage_layer_scan,
+    )
+
+    def layer_fn(h, lp, pos):
+        del pos
+        return _block(config, h, lp), jnp.zeros((), jnp.float32)
+
+    stage_fn = stage_layer_scan(layer_fn, remat=config.remat)
+    if pipe_size() > 1:
+        x, _aux = pipeline_apply(
+            stage_fn, params["layers"], x, positions,
+            n_microbatches=config.pipe_microbatches,
+        )
+    else:
+        x, _aux = stage_fn(params["layers"], x, positions)
+
+    x = _layer_norm(
+        x, params["final_ln_scale"], params["final_ln_bias"],
+        config.norm_eps,
+    )
+    head = (
+        params["embed"].T if config.tie_lm_head else params["lm_head"]
+    )
+    logits = x @ head.astype(dtype)
+    logits = shard_logical(logits, ("batch", "seq", "vocab"))
+    return logits.astype(jnp.float32)
+
+
+def gpt2_loss_fn(config: GPT2Config):
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        logits = gpt2_apply(config, params, tokens[:, :-1])
+        labels = tokens[:, 1:]
+        loss, valid = softmax_cross_entropy(logits, labels)
+        return loss.sum() / jnp.maximum(valid.sum(), 1)
+
+    return loss_fn

@@ -67,8 +67,16 @@ class RayClient:
     def create_actor(self, name: str, entrypoint, env: dict,
                      num_cpus: float = 1.0, resources=None):
         ray = self._ray
+        # adopt a surviving detached actor instead of colliding on the
+        # deterministic name (master restarted; workers lived on)
+        existing = self.get_actor(name)
+        if existing is not None:
+            self._actors[name] = existing
+            return existing
         # a CLASS-based remote: plain-function ray.remote would make a
-        # task (no name/namespace, not kill-able/get_actor-able)
+        # task (no name/namespace, not kill-able/get_actor-able).
+        # detached lifetime: workers survive a master restart; the
+        # namespace-wide list keeps them reachable afterwards.
         actor = ray.remote(
             num_cpus=num_cpus, resources=resources or {}
         )(_ActorRunner).options(
@@ -92,7 +100,19 @@ class RayClient:
             ray.kill(actor)
 
     def list_actors(self) -> list[str]:
-        return list(self._actors)
+        """Names of live actors in our namespace (survives a client
+        restart — backed by ray's named-actor registry, with the local
+        cache as fallback when the util API is unavailable)."""
+        try:
+            from ray.util import list_named_actors
+
+            named = list_named_actors(all_namespaces=True)
+            return [
+                a["name"] for a in named
+                if a.get("namespace") == self.namespace
+            ]
+        except Exception:  # noqa: BLE001 - older ray / not connected
+            return list(self._actors)
 
 
 class ActorScaler:

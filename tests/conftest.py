@@ -59,3 +59,13 @@ def local_master_2nodes():
 @pytest.fixture
 def free_port():
     return find_free_port()
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Tests that set a process-global mesh must not leak it into later
+    tests (e.g. a seq/pipe mesh changing model forward dispatch)."""
+    yield
+    from dlrover_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod._global_mesh = None

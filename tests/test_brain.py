@@ -86,15 +86,21 @@ class TestAlgorithms:
         )
         assert plan["memory_mb"] == 8192
 
-    def test_worker_count_best_throughput(self, brain):
+    def test_worker_count_efficiency_floor(self, brain):
         client, service = brain
+        # per-worker: 4 -> 10.0 (base), 8 -> 7.625 (76%, efficient),
+        # 16 -> 4.0 (40%, below the 70% floor)
         samples = [(4, 40.0), (8, 60.0), (16, 64.0), (8, 62.0)]
         for count, speed in samples:
             service.store.persist(
                 "job2", "j2", {"worker_count": count, "speed": speed}
             )
         plan = client.optimize("job2", "j2", "worker_count")
-        # 16 workers had the highest mean aggregate speed
+        assert plan["worker_count"] == 8
+        # a laxer floor admits 16
+        plan = client.optimize(
+            "job2", "j2", "worker_count", {"min_efficiency": 0.3}
+        )
         assert plan["worker_count"] == 16
 
     def test_unknown_opt_type(self, brain):
