@@ -23,6 +23,7 @@ from dlrover_tpu.master.job_manager import (
 )
 from dlrover_tpu.master.elastic_ps import ElasticPsService
 from dlrover_tpu.master.kvstore import KVStoreService, SyncService
+from dlrover_tpu.master.paral_tuner import ParalConfigGenerator
 from dlrover_tpu.master.rendezvous import (
     ElasticTrainingRendezvousManager,
     NetworkCheckRendezvousManager,
@@ -73,6 +74,11 @@ class LocalJobMaster(JobMaster):
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
         )
+        self.paral_generator = ParalConfigGenerator(
+            self.job_manager,
+            self.task_manager.speed_monitor,
+            self.task_manager,
+        )
 
     @property
     def port(self) -> int:
@@ -93,6 +99,8 @@ class LocalJobMaster(JobMaster):
             )
         self.task_manager.start()
         self.job_manager.start()
+        if getattr(self._job_args, "auto_tunning", False):
+            self.paral_generator.start()
         self._server.start()
         logger.info("LocalJobMaster serving on %s", self.addr)
 
@@ -123,6 +131,7 @@ class LocalJobMaster(JobMaster):
             self.stop()
 
     def stop(self):
+        self.paral_generator.stop()
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop()
@@ -176,6 +185,11 @@ class DistributedJobMaster(JobMaster):
             target_worker_num=getattr(job_args, "node_num", 0) or 0,
             node_unit=getattr(job_args, "node_unit", 1) or 1,
         )
+        self.paral_generator = ParalConfigGenerator(
+            self.job_manager,
+            self.task_manager.speed_monitor,
+            self.task_manager,
+        )
         self._exit_code = 0
         self._exit_reason = ""
 
@@ -203,6 +217,8 @@ class DistributedJobMaster(JobMaster):
         self.job_manager.start()
         if getattr(self._job_args, "auto_scaling", True):
             self.auto_scaler.start_auto_scaling()
+        if getattr(self._job_args, "auto_tunning", False):
+            self.paral_generator.start()
         logger.info(
             "DistributedJobMaster serving on port %s for job %s",
             self.port,
@@ -250,6 +266,7 @@ class DistributedJobMaster(JobMaster):
         return self._exit_code
 
     def stop(self):
+        self.paral_generator.stop()
         self.auto_scaler.stop_auto_scaling()
         self.task_manager.stop()
         self.job_manager.stop()

@@ -78,6 +78,15 @@ class TaskManager:
     def get_dataset(self, name: str) -> BatchDatasetManager | None:
         return self._datasets.get(name)
 
+    def first_dataset_batch_size(self) -> int:
+        """Batch size workers registered (0 when no dataset yet) — the
+        auto-tuner's starting point."""
+        for ds in self._datasets.values():
+            bs = getattr(ds, "_batch_size", 0)
+            if bs:
+                return int(bs)
+        return 0
+
     def get_dataset_task(self, node_type, node_id, dataset_name) -> Task:
         with self._lock:
             ds = self._datasets.get(dataset_name)

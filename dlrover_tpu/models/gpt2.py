@@ -165,6 +165,13 @@ def gpt2_apply(config: GPT2Config, params, tokens, positions=None):
     """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
     dtype = jnp.dtype(config.dtype)
     B, S = tokens.shape
+    if S > config.max_seq_len:
+        # JAX gather would silently clamp out-of-range positions to the
+        # last learned embedding row — reject at trace time instead
+        raise ValueError(
+            f"sequence length {S} exceeds max_seq_len "
+            f"{config.max_seq_len}"
+        )
     if positions is None:
         positions = jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.int32), (B, S)

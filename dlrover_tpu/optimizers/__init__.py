@@ -2,6 +2,12 @@ from dlrover_tpu.optimizers.agd import agd, scale_by_agd
 from dlrover_tpu.optimizers.wsam import make_wsam_grad_fn, wsam_update
 from dlrover_tpu.optimizers.low_bit import adam8bit, scale_by_adam8bit
 from dlrover_tpu.optimizers.group_sparse import group_adagrad, group_adam
+from dlrover_tpu.optimizers.mup import (
+    mup_adam,
+    mup_lr_multipliers,
+    mup_rescale_init,
+    scale_by_mup,
+)
 
 __all__ = [
     "agd",
@@ -12,4 +18,8 @@ __all__ = [
     "scale_by_adam8bit",
     "group_adam",
     "group_adagrad",
+    "mup_adam",
+    "mup_lr_multipliers",
+    "mup_rescale_init",
+    "scale_by_mup",
 ]

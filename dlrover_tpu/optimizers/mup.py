@@ -1,0 +1,110 @@
+"""muP (Maximal Update Parametrization) scaling helpers.
+
+Equivalent capability: reference atorch/atorch/mup/ — width-transfer
+hyperparameters: tune on a small model, scale width, keep the optimum.
+TPU redesign: muP here is two pure functions over the params pytree +
+its logical axes (the same contract auto_accelerate uses), plus an optax
+wrapper that applies per-leaf learning-rate multipliers — no module
+wrapping, composes with any strategy.
+
+Rules implemented (Tensor Programs V, Adam variant):
+- "hidden" weights (both dims scale with width, e.g. embed x mlp):
+  lr multiplier 1/width_mult, init scale 1/sqrt(width_mult);
+- output/readout layers (hidden -> vocab/logits): lr 1/width_mult and
+  init scaled by 1/width_mult;
+- input embeddings, biases, norms (at most one width dim): unchanged.
+Width classification comes from the logical axis names.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# logical axis names whose size scales with model width
+WIDTH_AXES = frozenset({"embed", "mlp", "heads", "kv_heads", "head_dim"})
+# axis names marking the readout dimension
+OUTPUT_AXES = frozenset({"vocab", "logits"})
+
+
+def _classify(axes: tuple | None) -> str:
+    """'hidden' | 'output' | 'input' from a leaf's logical axes."""
+    if not axes:
+        return "input"
+    names = [a for a in axes if a]
+    width = sum(1 for a in names if a in WIDTH_AXES)
+    has_out = any(a in OUTPUT_AXES for a in names)
+    if has_out and width >= 1:
+        # embed x vocab: output when width feeds the readout (vocab
+        # last); input embedding when vocab is the leading (lookup) dim
+        return "output" if names[-1] in OUTPUT_AXES else "input"
+    if width >= 2:
+        return "hidden"
+    return "input"
+
+
+def mup_lr_multipliers(param_logical_axes: Any,
+                       width_mult: float) -> Any:
+    """Per-leaf lr multipliers for the muP Adam rules."""
+    is_axes = lambda x: isinstance(x, tuple) or x is None  # noqa: E731
+
+    def mult(axes):
+        kind = _classify(axes)
+        if kind in ("hidden", "output"):
+            return 1.0 / width_mult
+        return 1.0
+
+    return jax.tree.map(mult, param_logical_axes, is_leaf=is_axes)
+
+
+def mup_rescale_init(params: Any, param_logical_axes: Any,
+                     width_mult: float) -> Any:
+    """Rescale a standard init to muP at width ``width_mult`` x base."""
+    is_axes = lambda x: isinstance(x, tuple) or x is None  # noqa: E731
+    flat_axes = jax.tree.leaves(
+        param_logical_axes, is_leaf=is_axes
+    )
+    flat_params, treedef = jax.tree.flatten(params)
+    out = []
+    for p, axes in zip(flat_params, flat_axes):
+        kind = _classify(axes)
+        if kind == "hidden":
+            out.append(p / jnp.sqrt(width_mult))
+        elif kind == "output":
+            out.append(p / width_mult)
+        else:
+            out.append(p)
+    return jax.tree.unflatten(treedef, out)
+
+
+def scale_by_mup(param_logical_axes: Any,
+                 width_mult: float) -> optax.GradientTransformation:
+    """Optax transform applying muP per-leaf lr multipliers; chain it
+    after the base optimizer: ``optax.chain(optax.adam(lr),
+    scale_by_mup(axes, width_mult))``."""
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        mults = mup_lr_multipliers(param_logical_axes, width_mult)
+        flat_m = jax.tree.leaves(mults)
+        flat_u, treedef = jax.tree.flatten(updates)
+        scaled = [u * m for u, m in zip(flat_u, flat_m)]
+        return jax.tree.unflatten(treedef, scaled), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def mup_adam(learning_rate, param_logical_axes, width_mult: float,
+             **adam_kwargs) -> optax.GradientTransformation:
+    """Adam with muP lr rules baked in."""
+    return optax.chain(
+        optax.scale_by_adam(**adam_kwargs),
+        scale_by_mup(param_logical_axes, width_mult),
+        optax.scale_by_learning_rate(learning_rate),
+    )

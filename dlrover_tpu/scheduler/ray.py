@@ -48,9 +48,17 @@ class _ActorRunner:
     def __init__(self, entrypoint, env):
         self._entrypoint = entrypoint
         self._env = env
+        self._state = "created"
 
     def run(self):
-        return self._entrypoint(self._env)
+        self._state = "running"
+        try:
+            return self._entrypoint(self._env)
+        finally:
+            self._state = "done"
+
+    def status(self):
+        return self._state
 
     def ping(self):
         return True
@@ -67,12 +75,26 @@ class RayClient:
     def create_actor(self, name: str, entrypoint, env: dict,
                      num_cpus: float = 1.0, resources=None):
         ray = self._ray
-        # adopt a surviving detached actor instead of colliding on the
-        # deterministic name (master restarted; workers lived on)
+        # A surviving detached actor (master restarted; workers lived
+        # on) is adopted ONLY if it is alive and still running its
+        # entrypoint; a corpse or an idle finished actor is killed and
+        # recreated — otherwise relaunch would mark the node PENDING
+        # with no worker process behind it.
         existing = self.get_actor(name)
         if existing is not None:
-            self._actors[name] = existing
-            return existing
+            try:
+                state = ray.get(
+                    existing.status.remote(), timeout=10
+                )
+            except Exception:  # noqa: BLE001 - dead/foreign actor
+                state = None
+            if state == "running":
+                self._actors[name] = existing
+                return existing
+            try:
+                ray.kill(existing)
+            except Exception:  # noqa: BLE001
+                pass
         # a CLASS-based remote: plain-function ray.remote would make a
         # task (no name/namespace, not kill-able/get_actor-able).
         # detached lifetime: workers survive a master restart; the

@@ -3,6 +3,10 @@ from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
 from dlrover_tpu.trainer.elastic.dataset import ElasticDataset
 from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer
 from dlrover_tpu.trainer.elastic.prefetch import DevicePrefetcher
+from dlrover_tpu.trainer.elastic.shm_loader import (
+    ShmBatchWriter,
+    ShmDataLoader,
+)
 
 __all__ = [
     "ElasticSampler",
@@ -10,4 +14,6 @@ __all__ = [
     "ElasticDataset",
     "ElasticTrainer",
     "DevicePrefetcher",
+    "ShmBatchWriter",
+    "ShmDataLoader",
 ]

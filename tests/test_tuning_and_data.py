@@ -1,0 +1,250 @@
+"""Tests for master-side paral-config generation, muP scaling, and the
+shm batch pipeline — reference coverage analogues: auto-tuning loop,
+atorch/mup, atorch/data/shm_dataloader.
+"""
+
+import multiprocessing as mp
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import NodeExitReason, NodeType
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.paral_tuner import ParalConfigGenerator
+from dlrover_tpu.optimizers.mup import (
+    _classify,
+    mup_adam,
+    mup_lr_multipliers,
+    mup_rescale_init,
+)
+
+
+class FakeJobManager:
+    def __init__(self, nodes):
+        self._nodes = nodes
+        self.pushed = []
+
+    def get_job_nodes(self, node_type=None):
+        return dict(self._nodes)
+
+    def update_all_paral_configs(self, config):
+        self.pushed.append(config)
+
+
+class FakeSpeed:
+    def __init__(self, speed=10.0):
+        self.running_speed = speed
+
+
+def worker(mem_limit=8192, mem_used=1024, oom=False, node_id=0):
+    n = Node(NodeType.WORKER, node_id,
+             config_resource=NodeResource(memory=mem_limit))
+    n.used_resource.memory = mem_used
+    if oom:
+        n.set_exit_reason(NodeExitReason.OOM)
+    return n
+
+
+class TestParalConfigGenerator:
+    def test_raises_batch_with_headroom(self):
+        mgr = FakeJobManager({0: worker(mem_used=1024)})
+        gen = ParalConfigGenerator(
+            mgr, FakeSpeed(), initial_batch_size=32
+        )
+        assert gen.tune_once()
+        cfg = mgr.pushed[-1]
+        assert cfg.dataloader.batch_size == 64
+        assert cfg.dataloader.version == 1
+
+    def test_halves_on_oom(self):
+        mgr = FakeJobManager({0: worker(oom=True)})
+        gen = ParalConfigGenerator(
+            mgr, FakeSpeed(), initial_batch_size=32
+        )
+        assert gen.tune_once()
+        assert mgr.pushed[-1].dataloader.batch_size == 16
+        # same OOM event does not halve twice
+        gen.tune_once()
+        assert mgr.pushed[-1].dataloader.batch_size != 8
+
+    def test_no_change_when_memory_tight(self):
+        mgr = FakeJobManager({0: worker(mem_used=7000)})
+        gen = ParalConfigGenerator(
+            mgr, FakeSpeed(), initial_batch_size=32
+        )
+        assert not gen.tune_once()
+
+    def test_caps_at_max(self):
+        mgr = FakeJobManager({0: worker(mem_used=100)})
+        gen = ParalConfigGenerator(
+            mgr, FakeSpeed(), initial_batch_size=32, max_batch_size=48
+        )
+        assert not gen.tune_once()
+
+    def test_end_to_end_via_master_and_dataloader(
+        self, local_master, tmp_path
+    ):
+        """Generator pushes -> agent tuner file -> ElasticDataLoader."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
+        from dlrover_tpu.trainer.elastic import (
+            ElasticDataLoader,
+            ElasticSampler,
+        )
+
+        client = MasterClient(local_master.addr, 0, NodeType.WORKER)
+        # simulate the generator pushing a tuned config
+        local_master.job_manager.update_node_paral_config(
+            NodeType.WORKER, 0, msg.ParallelConfig(
+                dataloader=msg.DataLoaderConfig(
+                    batch_size=8, version=1
+                )
+            ),
+        )
+        cfg_path = str(tmp_path / "paral.json")
+        tuner = ParalConfigTuner(client, config_path=cfg_path)
+        tuner.tune_once()
+
+        class DS:
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        dl = ElasticDataLoader(
+            DS(), batch_size=4, config_file=cfg_path,
+            sampler=ElasticSampler(32, shuffle=False),
+        )
+        assert next(iter(dl)).shape[0] == 8
+
+
+AXES = {
+    "embed": ("vocab", "embed"),
+    "hidden": ("embed", "mlp"),
+    "head": ("embed", "vocab"),
+    "norm": ("embed",),
+}
+
+
+class TestMup:
+    def test_classification(self):
+        assert _classify(("vocab", "embed")) == "input"
+        assert _classify(("embed", "mlp")) == "hidden"
+        assert _classify(("embed", "vocab")) == "output"
+        assert _classify(("embed",)) == "input"
+        assert _classify(None) == "input"
+
+    def test_lr_multipliers(self):
+        mults = mup_lr_multipliers(AXES, width_mult=4.0)
+        assert mults["embed"] == 1.0
+        assert mults["hidden"] == 0.25
+        assert mults["head"] == 0.25
+        assert mults["norm"] == 1.0
+
+    def test_rescale_init(self):
+        params = {k: jnp.ones((2, 2)) if len(v) == 2 else jnp.ones((2,))
+                  for k, v in AXES.items()}
+        scaled = mup_rescale_init(params, AXES, width_mult=4.0)
+        np.testing.assert_allclose(np.asarray(scaled["hidden"]), 0.5)
+        np.testing.assert_allclose(np.asarray(scaled["head"]), 0.25)
+        np.testing.assert_allclose(np.asarray(scaled["embed"]), 1.0)
+
+    def test_mup_adam_scales_updates(self):
+        params = {"hidden": jnp.ones((4, 4)), "norm": jnp.ones((4,))}
+        axes = {"hidden": ("embed", "mlp"), "norm": ("embed",)}
+        opt = mup_adam(1.0, axes, width_mult=8.0)
+        state = opt.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        updates, _ = opt.update(grads, state, params)
+        # Adam normalizes to ~1; hidden then scaled by 1/8
+        ratio = abs(float(updates["hidden"][0, 0])) / abs(
+            float(updates["norm"][0])
+        )
+        np.testing.assert_allclose(ratio, 1 / 8, rtol=1e-3)
+
+
+def _producer_proc(name, n_batches):
+    from dlrover_tpu.trainer.elastic.shm_loader import ShmBatchWriter
+
+    writer = ShmBatchWriter(name, slots=4, slot_bytes=1 << 20,
+                            create=False)
+    for i in range(n_batches):
+        writer.put({
+            "x": np.full((8, 4), i, np.float32),
+            "meta": {"idx": i},
+        })
+    writer.end()
+    writer.close()
+
+
+class TestShmDataLoader:
+    def test_roundtrip_same_process(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks")
+        )
+        from dlrover_tpu.trainer.elastic.shm_loader import (
+            ShmBatchWriter,
+            ShmDataLoader,
+        )
+
+        name = f"rt{os.getpid()}"
+        writer = ShmBatchWriter(name, slots=2, slot_bytes=1 << 20)
+        loader = ShmDataLoader(name, slots=2, slot_bytes=1 << 20)
+        writer.put({"x": np.arange(12).reshape(3, 4), "tag": "a"})
+        writer.put((np.ones(5), [1, 2]))
+        writer.end()
+        batches = list(loader)
+        assert len(batches) == 2
+        np.testing.assert_array_equal(
+            batches[0]["x"], np.arange(12).reshape(3, 4)
+        )
+        assert batches[0]["tag"] == "a"
+        assert isinstance(batches[1], tuple)
+        writer.close()
+        loader.close(unlink=True)
+
+    def test_cross_process(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks")
+        )
+        from dlrover_tpu.trainer.elastic.shm_loader import (
+            ShmBatchWriter,
+            ShmDataLoader,
+        )
+
+        name = f"xp{os.getpid()}"
+        # consumer side creates the queues/slab
+        writer_owner = ShmBatchWriter(name, slots=4, slot_bytes=1 << 20)
+        loader = ShmDataLoader(name, slots=4, slot_bytes=1 << 20)
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(target=_producer_proc, args=(name, 6))
+        proc.start()
+        batches = list(loader)
+        proc.join(timeout=60)
+        assert proc.exitcode == 0
+        assert len(batches) == 6
+        for i, b in enumerate(batches):
+            assert b["meta"]["idx"] == i
+            np.testing.assert_array_equal(
+                b["x"], np.full((8, 4), i, np.float32)
+            )
+        writer_owner.close()
+        loader.close(unlink=True)
+
+    def test_oversized_batch_rejected(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks")
+        )
+        from dlrover_tpu.trainer.elastic.shm_loader import ShmBatchWriter
+
+        name = f"big{os.getpid()}"
+        writer = ShmBatchWriter(name, slots=2, slot_bytes=1024)
+        with pytest.raises(ValueError, match="slot size"):
+            writer.put({"x": np.zeros(4096, np.float32)})
+        writer.close()
