@@ -60,3 +60,16 @@ from dlrover_tpu.parallel.engine import (  # noqa: F401
     estimate_hbm_per_device,
     search_strategy,
 )
+
+
+def get_shard_map():
+    """Version-compat shard_map (jax.shard_map >= 0.8, experimental
+    before) — single shim so tests/modules don't each carry a fallback."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as fn2
+
+    return fn2

@@ -122,7 +122,11 @@ def set_mesh(mesh) -> None:
 def get_mesh():
     """The active mesh: an enclosing ``with mesh:`` context if present,
     else the process-global one set by :func:`set_mesh`."""
-    from jax.interpreters.pxla import thread_resources
+    try:
+        # jax >= 0.8.2: the public pxla re-export is deprecated
+        from jax._src.mesh import thread_resources
+    except ImportError:  # older jax
+        from jax.interpreters.pxla import thread_resources
 
     env_mesh = thread_resources.env.physical_mesh
     if env_mesh is not None and not env_mesh.empty:

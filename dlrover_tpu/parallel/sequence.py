@@ -204,10 +204,9 @@ def sequence_sharded_attention(
                                causal=causal, sm_scale=sm_scale)
     else:
         raise ValueError(f"unknown sequence-parallel impl {impl!r}")
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:  # jax < 0.7
-        from jax.experimental.shard_map import shard_map
-    return shard_map(
+    from dlrover_tpu.parallel import get_shard_map
+
+    return get_shard_map()(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -77,7 +77,9 @@ def test_softmax_cross_entropy_ignore_index():
 
 def test_vocab_parallel_cross_entropy():
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from dlrover_tpu.parallel import get_shard_map
+
+    shard_map = get_shard_map()
 
     rng = np.random.RandomState(1)
     vocab, n_shard = 64, 4

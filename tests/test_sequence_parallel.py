@@ -15,10 +15,9 @@ from dlrover_tpu.parallel.sequence import (
     ulysses_attention,
 )
 
-try:
-    from jax import shard_map
-except ImportError:  # jax < 0.7
-    from jax.experimental.shard_map import shard_map
+from dlrover_tpu.parallel import get_shard_map
+
+shard_map = get_shard_map()
 
 
 def seq_mesh(n=4):
