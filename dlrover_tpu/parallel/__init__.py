@@ -63,13 +63,17 @@ from dlrover_tpu.parallel.engine import (  # noqa: F401
 
 
 def get_shard_map():
-    """Version-compat shard_map (jax.shard_map >= 0.8, experimental
-    before) — single shim so tests/modules don't each carry a fallback."""
+    """The framework's single shard_map access point.
+
+    jax >= 0.8 (where ``jax.shard_map`` is public) is the supported
+    floor — the pre-0.8 experimental variant had an incompatible
+    ``check_rep`` kwarg, so a silent fallback would TypeError at the
+    call sites anyway; fail loudly here instead."""
     import jax
 
     fn = getattr(jax, "shard_map", None)
-    if fn is not None:
-        return fn
-    from jax.experimental.shard_map import shard_map as fn2
-
-    return fn2
+    if fn is None:
+        raise ImportError(
+            "dlrover_tpu requires jax >= 0.8 (jax.shard_map missing)"
+        )
+    return fn

@@ -1,0 +1,106 @@
+"""Chaos e2e: a worker is killed mid-training after an in-memory flash
+checkpoint; the agent restarts it and the new incarnation resumes from
+the shm checkpoint (which survives worker death because the agent-side
+saver holds the segment) — the headline Flash Checkpoint capability
+(reference fault-tolerance experiments, SURVEY §4/§6; BASELINE north
+star: fast restore under injected preemption).
+"""
+
+import json
+import os
+
+import pytest
+
+from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training_agent import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    WorkerSpec,
+)
+from dlrover_tpu.common.constants import NodeType
+
+
+@pytest.fixture(autouse=True)
+def _isolate(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+    job = f"chaos{os.getpid()}"
+    monkeypatch.setenv("ELASTIC_JOB_NAME", job)
+    yield
+    AsyncCheckpointSaver.reset()
+    from dlrover_tpu.common.ipc import PersistentSharedMemory
+
+    try:
+        seg = PersistentSharedMemory(name=f"dlrtpu_ckpt_{job}_0")
+        seg.close()
+        seg.unlink()
+    except FileNotFoundError:
+        pass
+
+
+WORKER = """
+import json, os, sys
+import numpy as np
+import jax, jax.numpy as jnp
+from dlrover_tpu.trainer.flash_checkpoint.engine import (
+    ReplicatedCheckpointEngine,
+)
+
+out_dir = os.environ["CHAOS_OUT_DIR"]
+engine = ReplicatedCheckpointEngine(out_dir + "/ckpt")
+
+restored = engine.load()
+if restored is None:
+    start, w = 0, jnp.zeros((4,))
+else:
+    start = int(restored["step"])
+    w = jnp.asarray(list(restored["state"].values())[0])
+
+TOTAL, CRASH_AT = 10, 5
+for step in range(start + 1, TOTAL + 1):
+    w = w + 1.0
+    engine.save_to_memory(step, {"w": w})
+    if step == CRASH_AT and restored is None:
+        # injected preemption: die without any cleanup
+        os._exit(13)
+
+with open(out_dir + "/result.json", "w") as f:
+    json.dump({
+        "resumed_from": start,
+        "final_step": TOTAL,
+        "w0": float(w[0]),
+    }, f)
+engine.close()
+"""
+
+
+def test_kill_and_resume_from_shm(local_master, tmp_path):
+    script = tmp_path / "chaos_worker.py"
+    script.write_text(WORKER)
+    os.environ["CHAOS_OUT_DIR"] = str(tmp_path)
+
+    config = ElasticLaunchConfig(
+        min_nodes=1,
+        max_nodes=1,
+        nproc_per_node=1,
+        monitor_interval=0.3,
+        rdzv_timeout=30,
+        max_restarts=2,
+        log_dir=str(tmp_path),
+    )
+    client = MasterClient(local_master.addr, 0, NodeType.WORKER)
+    spec = WorkerSpec(str(script), (), config)
+    agent = ElasticTrainingAgent(config, spec, client)
+    try:
+        assert agent.run() == 0
+    finally:
+        client.close()
+        os.environ.pop("CHAOS_OUT_DIR", None)
+
+    result = json.loads((tmp_path / "result.json").read_text())
+    # the second incarnation must have resumed from the shm checkpoint
+    # taken right before the crash — not from scratch
+    assert result["resumed_from"] == 5, result
+    assert result["final_step"] == 10
+    # w incremented once per step with no replay: exactly 10
+    assert result["w0"] == 10.0, result
