@@ -45,8 +45,10 @@ class LlamaConfig:
     dtype: str = "bfloat16"          # activation/compute dtype
     attn_impl: str = "flash"         # "flash" | "reference"
     remat: bool = True               # checkpoint each scanned layer
-    attn_block_q: int = 512
-    attn_block_k: int = 512
+    # measured on v5e (nano-350m, seq 2048): 1024x1024 beats 512x512 by
+    # ~15% tokens/s; 2048-wide K blocks fail to fit VMEM
+    attn_block_q: int = 1024
+    attn_block_k: int = 1024
     # pipeline microbatches when the ``pipe`` mesh axis is active
     # (0 = default 2 * n_stages)
     pipe_microbatches: int = 0
@@ -248,7 +250,9 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt):
         ("batch", "heads", None, None), rules)
     kv_spec = logical_to_mesh_axes(
         ("batch", "kv_heads", None, None), rules)
-    return jax.shard_map(
+    from dlrover_tpu.parallel import get_shard_map
+
+    return get_shard_map()(
         kernel,
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),

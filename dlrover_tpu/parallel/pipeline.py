@@ -162,7 +162,9 @@ def pipeline_apply(
         return outbuf, aux_total
 
     n_extra = len(extra_mb)
-    out_mb, aux_total = jax.shard_map(
+    from dlrover_tpu.parallel import get_shard_map
+
+    out_mb, aux_total = get_shard_map()(
         schedule,
         mesh=mesh,
         in_specs=(

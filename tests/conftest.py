@@ -69,3 +69,24 @@ def _reset_global_mesh():
     from dlrover_tpu.parallel import mesh as mesh_mod
 
     mesh_mod._global_mesh = None
+
+
+@pytest.fixture
+def isolated_ckpt_env(tmp_path, monkeypatch):
+    """Job-scoped socket dir + shm + saver-singleton isolation shared by
+    the flash-checkpoint / trainer / chaos test files."""
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+    job = f"iso{os.getpid()}"
+    monkeypatch.setenv("ELASTIC_JOB_NAME", job)
+    yield job
+    from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+    from dlrover_tpu.common.ipc import PersistentSharedMemory
+
+    AsyncCheckpointSaver.reset()
+    for rank in range(4):
+        try:
+            seg = PersistentSharedMemory(name=f"dlrtpu_ckpt_{job}_{rank}")
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass

@@ -7,11 +7,7 @@ star: fast restore under injected preemption).
 """
 
 import json
-import os
 
-import pytest
-
-from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.training_agent import (
     ElasticLaunchConfig,
@@ -21,26 +17,8 @@ from dlrover_tpu.agent.training_agent import (
 from dlrover_tpu.common.constants import NodeType
 
 
-@pytest.fixture(autouse=True)
-def _isolate(tmp_path, monkeypatch):
-    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
-    job = f"chaos{os.getpid()}"
-    monkeypatch.setenv("ELASTIC_JOB_NAME", job)
-    yield
-    AsyncCheckpointSaver.reset()
-    from dlrover_tpu.common.ipc import PersistentSharedMemory
-
-    try:
-        seg = PersistentSharedMemory(name=f"dlrtpu_ckpt_{job}_0")
-        seg.close()
-        seg.unlink()
-    except FileNotFoundError:
-        pass
-
-
 WORKER = """
-import json, os, sys
-import numpy as np
+import json, os
 import jax, jax.numpy as jnp
 from dlrover_tpu.trainer.flash_checkpoint.engine import (
     ReplicatedCheckpointEngine,
@@ -74,10 +52,11 @@ engine.close()
 """
 
 
-def test_kill_and_resume_from_shm(local_master, tmp_path):
+def test_kill_and_resume_from_shm(local_master, tmp_path, monkeypatch,
+                                  isolated_ckpt_env):
     script = tmp_path / "chaos_worker.py"
     script.write_text(WORKER)
-    os.environ["CHAOS_OUT_DIR"] = str(tmp_path)
+    monkeypatch.setenv("CHAOS_OUT_DIR", str(tmp_path))
 
     config = ElasticLaunchConfig(
         min_nodes=1,
@@ -95,7 +74,6 @@ def test_kill_and_resume_from_shm(local_master, tmp_path):
         assert agent.run() == 0
     finally:
         client.close()
-        os.environ.pop("CHAOS_OUT_DIR", None)
 
     result = json.loads((tmp_path / "result.json").read_text())
     # the second incarnation must have resumed from the shm checkpoint
