@@ -212,6 +212,12 @@ class MasterClient:
 
     # -------------------------------------------------------------- config
 
+    def feed_streaming_dataset(self, dataset_name: str, count: int,
+                               end: bool = False) -> bool:
+        return self._report(msg.StreamingFeed(
+            dataset_name=dataset_name, count=count, end=end
+        ))
+
     def get_ps_version(self, version_type: str = "global") -> int:
         resp = self._get(msg.PsVersionRequest(version_type=version_type))
         return resp.version if resp is not None else 0

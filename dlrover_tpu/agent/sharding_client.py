@@ -10,8 +10,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -55,9 +57,18 @@ class ShardingClient:
             dataset_type=dataset_type,
         )
 
-    def fetch_shard(self):
-        """Returns the next Shard or None when the dataset is finished."""
-        task = self._client.get_task(self.dataset_name)
+    def fetch_shard(self, wait_interval: float = 1.0):
+        """Returns the next Shard or None when the dataset is finished.
+
+        Streaming datasets return WAIT tasks while momentarily dry; the
+        client blocks (polling) until data arrives or the stream ends.
+        """
+        while True:
+            task = self._client.get_task(self.dataset_name)
+            if task is not None and task.task_type == TaskType.WAIT:
+                time.sleep(wait_interval)
+                continue
+            break
         if task is None or task.task_id < 0:
             return None
         with self._lock:

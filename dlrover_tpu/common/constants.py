@@ -221,6 +221,8 @@ class TaskType:
     """Data-shard task types handed to workers."""
 
     NONE = "none"
+    # streaming dataset: no data available yet, client should retry
+    WAIT = "wait"
     TRAINING = "training"
     EVALUATION = "evaluation"
     PREDICTION = "prediction"

@@ -321,6 +321,15 @@ class ParallelConfig(Message):
 
 
 @dataclass
+class StreamingFeed(Message):
+    """Producer reports new records (or end) of a streaming dataset."""
+
+    dataset_name: str = ""
+    count: int = 0
+    end: bool = False
+
+
+@dataclass
 class PsVersionRequest(Message):
     # "global" | "local" | "restored" (master ElasticPsService)
     version_type: str = "global"

@@ -177,6 +177,10 @@ class MasterServicer(RpcService):
     # --------------------------------------------------------------- report
 
     def report(self, node_type: str, node_id: int, message) -> bool:
+        if isinstance(message, msg.StreamingFeed):
+            return self.task_manager.feed_streaming_dataset(
+                message.dataset_name, message.count, message.end
+            )
         if isinstance(message, msg.PsVersionReport):
             if self.elastic_ps_service is None:
                 return False

@@ -199,3 +199,114 @@ class BatchDatasetManager(DatasetManager):
                 )
             )
         self._create_tasks(shards)
+
+
+class StreamingDatasetManager(BatchDatasetManager):
+    """Unbounded stream: shards are cut as a producer reports records
+    (reference streaming_dataset_manager.py). get_task returns a WAIT
+    task while the stream is live but momentarily dry; the dataset only
+    completes after end_stream() and a full drain."""
+
+    def __init__(self, task_type: str, batch_size: int,
+                 shard_size: int = 0, dataset_name: str = "stream"):
+        # no splitter: shards come from reported records
+        super().__init__(task_type, batch_size, _NullSplitter())
+        self.dataset_name = dataset_name
+        self._shard_size = shard_size or batch_size * 2
+        self._next_record = 0   # first record not yet sharded
+        self._reported = 0      # total records the producer announced
+        self._ended = False
+
+    # -------------------------------------------------------- streaming
+
+    def add_records(self, count: int):
+        if count > 0 and not self._ended:
+            self._reported += int(count)
+            self._cut_shards()
+
+    def end_stream(self):
+        self._ended = True
+        self._cut_shards(include_tail=True)
+
+    def _cut_shards(self, include_tail: bool = False):
+        shards = []
+        while self._reported - self._next_record >= self._shard_size:
+            shards.append(Shard(
+                name="stream",
+                start=self._next_record,
+                end=self._next_record + self._shard_size,
+            ))
+            self._next_record += self._shard_size
+        if include_tail and self._reported > self._next_record:
+            shards.append(Shard(
+                name="stream",
+                start=self._next_record,
+                end=self._reported,
+            ))
+            self._next_record = self._reported
+        if shards:
+            self._create_tasks(shards)
+
+    # ------------------------------------------------------- overrides
+
+    def get_task(self, node_type, node_id) -> Task:
+        if not self.todo and not self._ended:
+            return Task(-1, TaskType.WAIT, Shard())
+        # the base pop/doing bookkeeping applies unchanged
+        # (_NullSplitter.epoch_finished() is always True)
+        return super().get_task(node_type, node_id)
+
+    def completed(self) -> bool:
+        return (
+            self._ended
+            and not self.todo
+            and not self.doing
+            and self._next_record >= self._reported
+        )
+
+    def get_epoch(self) -> int:
+        return 0
+
+    def checkpoint(self) -> str:
+        return json.dumps({
+            "streaming": True,
+            "dataset_name": self.dataset_name,
+            "next_record": self._next_record,
+            "reported": self._reported,
+            "ended": self._ended,
+            "todo": [
+                [t.task.shard.start, t.task.shard.end]
+                for t in self.doing.values()
+            ] + [[t.shard.start, t.shard.end] for t in self.todo],
+        })
+
+    def restore_checkpoint(self, content: str):
+        data = json.loads(content)
+        if not data.get("streaming"):
+            return
+        self._next_record = int(data["next_record"])
+        self._reported = int(data["reported"])
+        self._ended = bool(data["ended"])
+        self.todo.clear()
+        self.doing.clear()
+        shards = [
+            Shard(name="stream", start=a, end=b)
+            for a, b in data.get("todo", [])
+        ]
+        self._create_tasks(shards)
+
+
+class _NullSplitter:
+    """Placeholder splitter for streaming datasets (never has epochs)."""
+
+    def epoch_finished(self) -> bool:
+        return True
+
+    def create_shards(self):
+        pass
+
+    def get_shards(self):
+        return []
+
+    def get_epoch(self) -> int:
+        return 0

@@ -51,6 +51,22 @@ class TaskManager:
             if dataset_name in self._datasets:
                 logger.info("dataset %s already registered", dataset_name)
                 return
+            if dataset_type == "streaming":
+                from dlrover_tpu.master.shard.dataset_manager import (
+                    StreamingDatasetManager,
+                )
+
+                self._datasets[dataset_name] = StreamingDatasetManager(
+                    task_type,
+                    batch_size,
+                    shard_size=batch_size * num_minibatches_per_shard,
+                    dataset_name=dataset_name,
+                )
+                logger.info(
+                    "new streaming dataset %s: batch=%d", dataset_name,
+                    batch_size,
+                )
+                return
             if dataset_splitter is None:
                 shard_size = max(
                     batch_size * num_minibatches_per_shard, 1
@@ -77,6 +93,24 @@ class TaskManager:
 
     def get_dataset(self, name: str) -> BatchDatasetManager | None:
         return self._datasets.get(name)
+
+    def feed_streaming_dataset(self, dataset_name: str, count: int,
+                               end: bool = False) -> bool:
+        """Producer-side feed for streaming datasets. Holds the manager
+        lock: feeds and get_task run on different RPC handler threads."""
+        from dlrover_tpu.master.shard.dataset_manager import (
+            StreamingDatasetManager,
+        )
+
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if not isinstance(ds, StreamingDatasetManager):
+                return False
+            if count:
+                ds.add_records(count)
+            if end:
+                ds.end_stream()
+            return True
 
     def first_dataset_batch_size(self) -> int:
         """Batch size workers registered (0 when no dataset yet) — the

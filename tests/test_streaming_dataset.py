@@ -1,0 +1,123 @@
+"""Tests for streaming (unbounded) datasets — reference coverage
+analogue: master/shard/streaming_dataset_manager.py. A producer feeds
+records through the master; consumers block on WAIT tasks while the
+stream is dry and drain fully after end-of-stream.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.sharding_client import ShardingClient
+from dlrover_tpu.common.constants import NodeType, TaskType
+from dlrover_tpu.master.shard.dataset_manager import (
+    StreamingDatasetManager,
+)
+
+
+class TestStreamingManager:
+    def test_wait_then_serve(self):
+        m = StreamingDatasetManager("training", batch_size=4,
+                                    shard_size=8)
+        t = m.get_task("worker", 0)
+        assert t.task_type == TaskType.WAIT
+        m.add_records(20)  # 2 full shards + 4 leftover
+        s1 = m.get_task("worker", 0)
+        s2 = m.get_task("worker", 0)
+        assert (s1.shard.start, s1.shard.end) == (0, 8)
+        assert (s2.shard.start, s2.shard.end) == (8, 16)
+        # leftover is below shard_size: wait again
+        assert m.get_task("worker", 0).task_type == TaskType.WAIT
+        m.end_stream()
+        tail = m.get_task("worker", 0)
+        assert (tail.shard.start, tail.shard.end) == (16, 20)
+        # stream ended and drained: invalid task, not WAIT
+        final = m.get_task("worker", 0)
+        assert final.task_id < 0
+        assert final.task_type != TaskType.WAIT
+
+    def test_completed_only_after_drain(self):
+        m = StreamingDatasetManager("training", 4, shard_size=4)
+        m.add_records(4)
+        assert not m.completed()
+        task = m.get_task("worker", 0)
+        m.end_stream()
+        assert not m.completed()  # task still doing
+        m.report_task_status(task.task_id, True)
+        assert m.completed()
+
+    def test_checkpoint_carries_dataset_name(self):
+        import json
+
+        m = StreamingDatasetManager("training", 4, shard_size=4,
+                                    dataset_name="my-stream")
+        m.add_records(4)
+        state = json.loads(m.checkpoint())
+        # TaskManager.restore_dataset_from_checkpoint routes by this key
+        assert state["dataset_name"] == "my-stream"
+
+    def test_checkpoint_roundtrip(self):
+        m = StreamingDatasetManager("training", 4, shard_size=4)
+        m.add_records(12)
+        t = m.get_task("worker", 0)  # shard 0-4 in doing
+        state = m.checkpoint()
+
+        m2 = StreamingDatasetManager("training", 4, shard_size=4)
+        m2.restore_checkpoint(state)
+        # all three shards (the doing one included) must be servable
+        starts = set()
+        for _ in range(3):
+            task = m2.get_task("worker", 1)
+            starts.add(task.shard.start)
+        assert starts == {0, 4, 8}
+        del t
+
+
+class TestStreamingEndToEnd:
+    def test_producer_consumer_via_master(self, local_master):
+        client = MasterClient(local_master.addr, 0, NodeType.WORKER)
+        try:
+            sharding = ShardingClient(
+                dataset_name="stream-e2e", batch_size=4, num_epochs=1,
+                dataset_size=0, dataset_type="streaming",
+                master_client=client, num_minibatches_per_shard=1,
+            )
+
+            def produce():
+                for _ in range(3):
+                    time.sleep(0.2)
+                    client.feed_streaming_dataset("stream-e2e", 8)
+                client.feed_streaming_dataset("stream-e2e", 0, end=True)
+
+            producer = threading.Thread(target=produce, daemon=True)
+            producer.start()
+
+            consumed = []
+            while True:
+                shard = sharding.fetch_shard(wait_interval=0.1)
+                if shard is None:
+                    break
+                consumed.append((shard.start, shard.end))
+                sharding.report_batch_done()
+            producer.join(timeout=10)
+            # 24 records in shards of 4 (batch_size * 1 minibatch)
+            assert len(consumed) == 6
+            assert consumed[0] == (0, 4)
+            assert consumed[-1] == (20, 24)
+            ds = local_master.task_manager.get_dataset("stream-e2e")
+            assert ds.completed()
+        finally:
+            client.close()
+
+    def test_feed_wrong_dataset_type_rejected(self, local_master):
+        client = MasterClient(local_master.addr, 0, NodeType.WORKER)
+        try:
+            client.report_dataset_shard_params(
+                batch_size=4, num_epochs=1, dataset_size=16,
+                dataset_name="table-ds",
+            )
+            assert not client.feed_streaming_dataset("table-ds", 8)
+        finally:
+            client.close()
