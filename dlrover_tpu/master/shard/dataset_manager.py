@@ -212,17 +212,27 @@ class StreamingDatasetManager(BatchDatasetManager):
         # no splitter: shards come from reported records
         super().__init__(task_type, batch_size, _NullSplitter())
         self.dataset_name = dataset_name
-        self._shard_size = shard_size or batch_size * 2
+        # never 0: a zero shard size would loop forever in _cut_shards
+        self._shard_size = max(shard_size or batch_size * 2, 1)
         self._next_record = 0   # first record not yet sharded
         self._reported = 0      # total records the producer announced
         self._ended = False
 
     # -------------------------------------------------------- streaming
 
-    def add_records(self, count: int):
-        if count > 0 and not self._ended:
+    def add_records(self, count: int) -> bool:
+        """Returns False when records arrive after end-of-stream (the
+        data would be silently lost otherwise)."""
+        if count > 0 and self._ended:
+            logger.warning(
+                "streaming dataset %s: %d records fed after end-of-"
+                "stream were DROPPED", self.dataset_name, count,
+            )
+            return False
+        if count > 0:
             self._reported += int(count)
             self._cut_shards()
+        return True
 
     def end_stream(self):
         self._ended = True
@@ -232,14 +242,14 @@ class StreamingDatasetManager(BatchDatasetManager):
         shards = []
         while self._reported - self._next_record >= self._shard_size:
             shards.append(Shard(
-                name="stream",
+                name=self.dataset_name,
                 start=self._next_record,
                 end=self._next_record + self._shard_size,
             ))
             self._next_record += self._shard_size
         if include_tail and self._reported > self._next_record:
             shards.append(Shard(
-                name="stream",
+                name=self.dataset_name,
                 start=self._next_record,
                 end=self._reported,
             ))
@@ -274,6 +284,7 @@ class StreamingDatasetManager(BatchDatasetManager):
             "next_record": self._next_record,
             "reported": self._reported,
             "ended": self._ended,
+            "completed_step": self._completed_step,
             "todo": [
                 [t.task.shard.start, t.task.shard.end]
                 for t in self.doing.values()
@@ -287,10 +298,11 @@ class StreamingDatasetManager(BatchDatasetManager):
         self._next_record = int(data["next_record"])
         self._reported = int(data["reported"])
         self._ended = bool(data["ended"])
+        self._completed_step = int(data.get("completed_step", 0))
         self.todo.clear()
         self.doing.clear()
         shards = [
-            Shard(name="stream", start=a, end=b)
+            Shard(name=self.dataset_name, start=a, end=b)
             for a, b in data.get("todo", [])
         ]
         self._create_tasks(shards)

@@ -14,6 +14,7 @@ from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.monitor import SpeedMonitor
 from dlrover_tpu.master.shard.dataset_manager import (
     BatchDatasetManager,
+    StreamingDatasetManager,
     Task,
 )
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
@@ -52,10 +53,6 @@ class TaskManager:
                 logger.info("dataset %s already registered", dataset_name)
                 return
             if dataset_type == "streaming":
-                from dlrover_tpu.master.shard.dataset_manager import (
-                    StreamingDatasetManager,
-                )
-
                 self._datasets[dataset_name] = StreamingDatasetManager(
                     task_type,
                     batch_size,
@@ -98,19 +95,16 @@ class TaskManager:
                                end: bool = False) -> bool:
         """Producer-side feed for streaming datasets. Holds the manager
         lock: feeds and get_task run on different RPC handler threads."""
-        from dlrover_tpu.master.shard.dataset_manager import (
-            StreamingDatasetManager,
-        )
-
         with self._lock:
             ds = self._datasets.get(dataset_name)
             if not isinstance(ds, StreamingDatasetManager):
                 return False
+            ok = True
             if count:
-                ds.add_records(count)
+                ok = ds.add_records(count)
             if end:
                 ds.end_stream()
-            return True
+            return ok
 
     def first_dataset_batch_size(self) -> int:
         """Batch size workers registered (0 when no dataset yet) — the

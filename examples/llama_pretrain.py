@@ -1,0 +1,137 @@
+"""Llama pretraining example: the full elastic stack in one script.
+
+Equivalent capability: reference atorch/examples/llama2 (FSDP/3D-parallel
+Llama-2 pretraining scripts) and examples/pytorch/ (elastic training
+with dlrover-run).
+
+Run single-host (a local master is spawned automatically):
+
+    tpu-run --nnodes 1 --nproc_per_node 1 --auto-tunning \
+        examples/llama_pretrain.py --preset nano-350m --steps 200
+
+Multi-host (one command per host, DLROVER_MASTER_ADDR pointing at the
+job master):
+
+    tpu-run --nnodes 4 --node_rank $RANK --network-check \
+        examples/llama_pretrain.py --preset llama2-7b
+
+What this shows, end to end:
+- master-coordinated rendezvous -> jax.distributed init (init_distributed)
+- auto_strategy / search_strategy -> auto_accelerate sharded train step
+- elastic dataloader with mid-epoch checkpoint/resume across world-size
+  changes (swap in ElasticDataset for master-served shard assignment)
+- Flash Checkpoint: async shm saves every --save-steps, storage persist,
+  automatic resume after restarts
+- runtime metrics + step timing flowing to the agent/master
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser("llama_pretrain")
+    p.add_argument("--preset", default="nano-350m")
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--optimizer", default="adamw",
+                   choices=["adamw", "sgd", "agd", "adam8bit"])
+    p.add_argument("--output-dir", default="/tmp/llama_pretrain")
+    p.add_argument("--save-steps", type=int, default=50)
+    p.add_argument("--search-strategy", action="store_true",
+                   help="measured strategy search instead of heuristics")
+    return p.parse_args()
+
+
+def synthetic_token_stream(vocab_size: int, seq_len: int, n_samples: int):
+    """Stand-in corpus: replace with your tokenized dataset."""
+
+    class DS:
+        def __len__(self):
+            return n_samples
+
+        def __getitem__(self, idx):
+            rng = np.random.RandomState(idx)
+            return rng.randint(
+                0, vocab_size, size=(seq_len + 1,), dtype=np.int32
+            )
+
+    return DS()
+
+
+def main():
+    args = parse_args()
+
+    from dlrover_tpu import trainer as tpu_trainer
+
+    tpu_trainer.init_distributed()
+
+    from dlrover_tpu.models import (
+        PRESETS,
+        llama_init,
+        llama_logical_axes,
+        llama_loss_fn,
+    )
+    from dlrover_tpu.parallel import auto_strategy
+    from dlrover_tpu.trainer import Trainer, TrainingArgs
+    from dlrover_tpu.trainer.elastic import (
+        ElasticDataLoader,
+        ElasticSampler,
+    )
+
+    config = PRESETS[args.preset]
+    n_devices = jax.device_count()
+    strategy = auto_strategy(
+        n_devices,
+        param_count=config.param_count(),
+        seq_len=args.seq_len,
+        devices_per_host=max(len(jax.local_devices()), 1),
+    )
+
+    dataset = synthetic_token_stream(
+        config.vocab_size, args.seq_len, n_samples=1 << 16
+    )
+    loader = ElasticDataLoader(
+        dataset,
+        batch_size=args.batch_size,
+        sampler=ElasticSampler(
+            len(dataset),
+            num_replicas=max(tpu_trainer.world_size(), 1),
+            rank=tpu_trainer.global_rank(),
+            shuffle=True,
+        ),
+        collate_fn=lambda rows: {"tokens": np.stack(rows)},
+    )
+
+    trainer = Trainer(
+        llama_loss_fn(config),
+        lambda rng: llama_init(config, rng),
+        llama_logical_axes(config),
+        TrainingArgs(
+            output_dir=args.output_dir,
+            max_steps=args.steps,
+            num_epochs=1_000_000,  # run the step budget out
+            learning_rate=args.lr,
+            optimizer=args.optimizer,
+            strategy=strategy,
+            flash_checkpoint=True,
+            save_steps=args.save_steps,
+            log_steps=10,
+        ),
+        train_data=loader,
+    )
+    state, metrics = trainer.train()
+    loss = float(metrics.get("loss", jnp.nan))
+    print(f"done: step={trainer.global_step} loss={loss:.4f}")
+    trainer.close()
+
+
+if __name__ == "__main__":
+    main()
