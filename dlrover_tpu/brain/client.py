@@ -124,26 +124,36 @@ class BrainReporter:
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
 
-    def collect_metrics(self) -> dict:
-        metrics: dict = {"status": "running"}
-        if self._speed_monitor is not None:
-            metrics["speed"] = self._speed_monitor.running_speed
-            metrics["global_step"] = (
-                self._speed_monitor.completed_global_step
-            )
-        if self._job_manager is not None:
-            nodes = self._job_manager.get_job_nodes(NodeType.WORKER)
-            alive = [
-                n for n in nodes.values() if not n.is_released
-            ]
-            metrics["worker_count"] = len(alive)
-            mems = [
-                n.used_resource.memory for n in alive
-                if n.used_resource.memory
-            ]
-            if mems:
-                metrics["used_memory_mb"] = max(mems)
+    @staticmethod
+    def _sample_to_metrics(sample) -> dict:
+        metrics: dict = {
+            "status": "running",
+            "speed": sample.speed,
+            "global_step": sample.global_step,
+            "worker_count": sample.worker_count,
+        }
+        if sample.max_used_memory_mb:
+            metrics["used_memory_mb"] = sample.max_used_memory_mb
         return metrics
+
+    def collect_metrics(self) -> dict:
+        # single source of truth for the runtime reduction: the stats
+        # sampler (master/stats.py) — no drift between the master's
+        # local history and the brain-reported metrics
+        from dlrover_tpu.master.stats import JobMetricCollector
+
+        sample = JobMetricCollector(
+            self._job_manager, self._speed_monitor, reporters=[]
+        ).collect_runtime_once()
+        return self._sample_to_metrics(sample)
+
+    def report_runtime(self, sample) -> bool:
+        """Reporter hook: lets a JobMetricCollector fan its samples out
+        to the brain (the intended composition)."""
+        return self._client.persist_metrics(
+            self._job_uuid, self._job_name,
+            self._sample_to_metrics(sample),
+        )
 
     def report_once(self) -> bool:
         return self._client.persist_metrics(

@@ -24,6 +24,7 @@ from dlrover_tpu.master.job_manager import (
 from dlrover_tpu.master.elastic_ps import ElasticPsService
 from dlrover_tpu.master.kvstore import KVStoreService, SyncService
 from dlrover_tpu.master.paral_tuner import ParalConfigGenerator
+from dlrover_tpu.master.stats import JobMetricCollector
 from dlrover_tpu.master.rendezvous import (
     ElasticTrainingRendezvousManager,
     NetworkCheckRendezvousManager,
@@ -160,6 +161,9 @@ class DistributedJobMaster(JobMaster):
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
         self.elastic_ps_service = ElasticPsService()
+        self.metric_collector = JobMetricCollector(
+            self.job_manager, self.task_manager.speed_monitor
+        )
         self._server, self.servicer = create_master_service(
             port,
             task_manager=self.task_manager,
@@ -168,6 +172,7 @@ class DistributedJobMaster(JobMaster):
             kv_store=self.kv_store,
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
+            job_metric_collector=self.metric_collector,
         )
         # Dead nodes must leave rendezvous waiting sets and give their
         # in-flight shards back (code-review finding: these existed but
@@ -219,6 +224,7 @@ class DistributedJobMaster(JobMaster):
             self.auto_scaler.start_auto_scaling()
         if getattr(self._job_args, "auto_tunning", False):
             self.paral_generator.start()
+        self.metric_collector.start()
         logger.info(
             "DistributedJobMaster serving on port %s for job %s",
             self.port,
@@ -258,6 +264,7 @@ class DistributedJobMaster(JobMaster):
             pass
         finally:
             self.stop()
+        self.metric_collector.collect_job_exit(self._exit_reason)
         logger.info(
             "master exiting: code=%s reason=%s",
             self._exit_code,
@@ -266,6 +273,7 @@ class DistributedJobMaster(JobMaster):
         return self._exit_code
 
     def stop(self):
+        self.metric_collector.stop()
         self.paral_generator.stop()
         self.auto_scaler.stop_auto_scaling()
         self.task_manager.stop()

@@ -126,7 +126,7 @@ class ShmBatchWriter:
         """Signal end-of-data to the consumer."""
         self._filled.put(_END)
 
-    def close(self):
+    def close(self, unlink: bool = False):
         # creator side also tears down the queue socket servers so a
         # later session with the same name starts fresh
         if self._owns_queues:
@@ -135,7 +135,7 @@ class ShmBatchWriter:
                     q.unlink()
                 except Exception:  # noqa: BLE001
                     pass
-        self._slab.close()
+        self._slab.close(unlink=unlink)
 
 
 class ShmDataLoader:

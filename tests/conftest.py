@@ -83,9 +83,32 @@ def isolated_ckpt_env(tmp_path, monkeypatch):
     from dlrover_tpu.common.ipc import PersistentSharedMemory
 
     AsyncCheckpointSaver.reset()
-    for rank in range(4):
+    names = [f"dlrtpu_ckpt_{job}_{rank}" for rank in range(4)]
+    names.append(f"dlrtpu_timer_{job}")  # StepTimer ring (Trainer)
+    for name in names:
         try:
-            seg = PersistentSharedMemory(name=f"dlrtpu_ckpt_{job}_{rank}")
+            seg = PersistentSharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _session_shm_sweep():
+    """Agent subprocesses spawned by e2e tests create persistent timer
+    rings (by design they survive process death); sweep them when the
+    test session ends so repeated runs don't accumulate segments."""
+    import glob
+
+    before = set(glob.glob("/dev/shm/dlrtpu_timer_*"))
+    yield
+    from dlrover_tpu.common.ipc import PersistentSharedMemory
+
+    for path in set(glob.glob("/dev/shm/dlrtpu_timer_*")) - before:
+        name = os.path.basename(path)
+        try:
+            seg = PersistentSharedMemory(name=name)
             seg.close()
             seg.unlink()
         except FileNotFoundError:

@@ -3,7 +3,6 @@
 test_ckpt_saver.py pattern: everything in one process, shm + unix-socket
 queues work intra-process)."""
 
-import os
 
 import jax
 import jax.numpy as jnp
@@ -23,22 +22,10 @@ from dlrover_tpu.trainer.flash_checkpoint.engine import (
 
 
 @pytest.fixture(autouse=True)
-def _isolate_ipc(tmp_path, monkeypatch):
-    """Fresh socket dir, job-scoped shm, saver singleton reset per test."""
-    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
-    job = f"pytest{os.getpid()}"
-    monkeypatch.setenv("ELASTIC_JOB_NAME", job)
+def _isolate_ipc(isolated_ckpt_env):
+    """Delegates to the shared shm/saver isolation fixture
+    (tests/conftest.py)."""
     yield
-    AsyncCheckpointSaver.reset()
-    from dlrover_tpu.common.ipc import PersistentSharedMemory
-
-    try:
-        seg = PersistentSharedMemory(name=f"dlrtpu_ckpt_{job}_0")
-        seg.close()
-        seg.unlink()
-    except FileNotFoundError:
-        pass
-
 
 def make_state(seed=0):
     k = jax.random.PRNGKey(seed)

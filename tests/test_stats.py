@@ -1,0 +1,80 @@
+"""Tests for the master stats layer (JobMetricCollector +
+LocalStatsReporter) — reference coverage analogue: master/stats tests.
+"""
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.stats import (
+    JobMetricCollector,
+    LocalStatsReporter,
+    RuntimeSample,
+)
+
+
+class FakeJobManager:
+    def __init__(self):
+        n0 = Node(NodeType.WORKER, 0)
+        n0.used_resource.memory = 2048
+        n1 = Node(NodeType.WORKER, 1)
+        n1.used_resource.memory = 4096
+        self._nodes = {0: n0, 1: n1}
+
+    def get_job_nodes(self, node_type=None):
+        return dict(self._nodes)
+
+
+class FakeSpeed:
+    running_speed = 12.5
+    completed_global_step = 420
+
+
+class TestLocalStatsReporter:
+    def test_history_bounded(self):
+        r = LocalStatsReporter()
+        r.MAX_SAMPLES = 10
+        for i in range(25):
+            r.report_runtime(RuntimeSample(global_step=i))
+        assert len(r.metrics.runtime) == 10
+        assert r.latest().global_step == 24
+
+    def test_dataset_and_exit(self):
+        r = LocalStatsReporter()
+        r.report_dataset("train", 1000, 32)
+        r.report_exit("Succeeded")
+        assert r.metrics.dataset_name == "train"
+        assert r.metrics.batch_size == 32
+        assert r.metrics.exit_reason == "Succeeded"
+
+
+class TestJobMetricCollector:
+    def test_collect_runtime(self):
+        c = JobMetricCollector(FakeJobManager(), FakeSpeed())
+        sample = c.collect_runtime_once()
+        assert sample.speed == 12.5
+        assert sample.global_step == 420
+        assert sample.worker_count == 2
+        assert sample.max_used_memory_mb == 4096
+        assert c.local_reporter.latest() is sample
+
+    def test_collect_dataset_metric(self):
+        c = JobMetricCollector()
+
+        class P:
+            dataset_name = "ds"
+            dataset_size = 64
+            batch_size = 8
+
+        c.collect_dataset_metric(P())
+        assert c.local_reporter.metrics.dataset_name == "ds"
+
+    def test_wired_in_distributed_master(self):
+        from dlrover_tpu.master.master import DistributedJobMaster
+        from dlrover_tpu.scheduler.job import new_job_args
+
+        master = DistributedJobMaster(
+            0, new_job_args("local", "stats-job", node_num=1)
+        )
+        assert master.servicer.job_metric_collector is \
+            master.metric_collector
+        master.metric_collector.collect_runtime_once()
+        assert master.metric_collector.local_reporter.latest() is not None

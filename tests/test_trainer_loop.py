@@ -3,7 +3,6 @@ checkpoint, resume, eval. Reference coverage analogue:
 atorch/tests trainer tests.
 """
 
-import os
 
 import jax
 import jax.numpy as jnp
@@ -15,22 +14,10 @@ from dlrover_tpu.trainer.trainer import Trainer, TrainingArgs
 
 
 @pytest.fixture(autouse=True)
-def _isolate(tmp_path, monkeypatch):
-    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
-    job = f"trainer{os.getpid()}"
-    monkeypatch.setenv("ELASTIC_JOB_NAME", job)
+def _isolate(isolated_ckpt_env):
+    """Delegates to the shared shm/saver isolation fixture
+    (tests/conftest.py)."""
     yield
-    AsyncCheckpointSaver.reset()
-    from dlrover_tpu.common.ipc import PersistentSharedMemory
-
-    for name in (f"dlrtpu_ckpt_{job}_0", f"dlrtpu_timer_{job}"):
-        try:
-            seg = PersistentSharedMemory(name=name)
-            seg.close()
-            seg.unlink()
-        except FileNotFoundError:
-            pass
-
 
 def linear_problem():
     def init_fn(rng):

@@ -247,4 +247,4 @@ class TestShmDataLoader:
         writer = ShmBatchWriter(name, slots=2, slot_bytes=1024)
         with pytest.raises(ValueError, match="slot size"):
             writer.put({"x": np.zeros(4096, np.float32)})
-        writer.close()
+        writer.close(unlink=True)
