@@ -124,16 +124,18 @@ class BrainReporter:
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
 
-    @staticmethod
-    def _sample_to_metrics(sample) -> dict:
-        metrics: dict = {
-            "status": "running",
-            "speed": sample.speed,
-            "global_step": sample.global_step,
-            "worker_count": sample.worker_count,
-        }
-        if sample.max_used_memory_mb:
-            metrics["used_memory_mb"] = sample.max_used_memory_mb
+    def _sample_to_metrics(self, sample) -> dict:
+        # keys are present only when their source was configured: a
+        # brain-side consumer must distinguish "metric unavailable"
+        # from "measured zero"
+        metrics: dict = {"status": "running"}
+        if self._speed_monitor is not None:
+            metrics["speed"] = sample.speed
+            metrics["global_step"] = sample.global_step
+        if self._job_manager is not None:
+            metrics["worker_count"] = sample.worker_count
+            if sample.max_used_memory_mb:
+                metrics["used_memory_mb"] = sample.max_used_memory_mb
         return metrics
 
     def collect_metrics(self) -> dict:

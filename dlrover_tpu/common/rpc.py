@@ -102,7 +102,10 @@ class RpcServer:
         self._thread.start()
 
     def stop(self, grace=None):
-        self._server.shutdown()
+        # shutdown() blocks forever if serve_forever never ran — a
+        # constructed-but-never-started server must still stop cleanly
+        if self._thread is not None:
+            self._server.shutdown()
         self._server.server_close()
 
 

@@ -75,7 +75,11 @@ class JobMetricCollector:
                  reporters=None, interval: float = 30.0):
         self._job_manager = job_manager
         self._speed_monitor = speed_monitor
-        self.reporters = list(reporters or [LocalStatsReporter()])
+        # explicit [] means "no reporters" (one-shot sampling); only
+        # None gets the default local history
+        self.reporters = list(
+            reporters if reporters is not None else [LocalStatsReporter()]
+        )
         self._interval = interval
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None

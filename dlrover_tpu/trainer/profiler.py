@@ -1,0 +1,94 @@
+"""Profiling helpers: XLA/XPlane traces + step-window capture.
+
+Equivalent capability: reference tracing stack (SURVEY §5a-d) — xpu_timer
+native kernel timing (covered by the shm TimerRing), ATorch dry-runner
+profiling (covered by parallel/engine.DryRunner), and torch-profiler
+style trace capture. The TPU-native trace is jax.profiler's XPlane/
+TensorBoard format, which records every XLA op, fusion, and ICI
+collective with device timelines — richer than an LD_PRELOAD hook, no
+native code needed.
+
+Usage in a training loop::
+
+    prof = StepProfiler(log_dir, start_step=10, num_steps=3)
+    for step in range(n):
+        prof.maybe_start(step)
+        state, m = train_step(state, batch, rng)
+        prof.maybe_stop(step)
+
+or one-shot::
+
+    with trace("/tmp/prof"):
+        train_step(...)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XPlane trace of the enclosed block (TensorBoard- and
+    xprof-compatible)."""
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profile trace written to %s", log_dir)
+
+
+class StepProfiler:
+    """Captures a window of training steps (the reference pattern of
+    profiling steps [start, start+num) once warmup is done)."""
+
+    def __init__(self, log_dir: str, start_step: int = 10,
+                 num_steps: int = 3):
+        self.log_dir = log_dir
+        self.start_step = int(start_step)
+        self.stop_step = int(start_step) + int(num_steps)
+        self._active = False
+        self._done = False
+
+    def maybe_start(self, step: int):
+        # >= not ==: a checkpoint resume past the window still profiles,
+        # starting at the first available step
+        if self._done or self._active or step < self.start_step:
+            return
+        if step > self.start_step:
+            self.stop_step = step + (self.stop_step - self.start_step)
+            self.start_step = step
+        import jax
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+        logger.info("profiling steps [%d, %d) -> %s",
+                    self.start_step, self.stop_step, self.log_dir)
+
+    def maybe_stop(self, step: int):
+        if not self._active or step < self.stop_step - 1:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        logger.info("profile window complete: %s", self.log_dir)
+
+    def close(self):
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

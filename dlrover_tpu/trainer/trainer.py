@@ -48,6 +48,11 @@ class TrainingArgs:
     # logging/eval
     log_steps: int = 10
     eval_steps: int = 0
+    # profiling: capture an XPlane trace of steps
+    # [profile_start_step, +profile_num_steps) into output_dir/profile
+    profile: bool = False
+    profile_start_step: int = 10
+    profile_num_steps: int = 3
 
 
 def _build_optimizer(args: TrainingArgs):
@@ -127,6 +132,15 @@ class Trainer:
             self._timer = get_step_timer()
         except Exception:  # noqa: BLE001 - shm unavailable (bare env)
             pass
+        self._profiler = None
+        if args.profile:
+            from dlrover_tpu.trainer.profiler import StepProfiler
+
+            self._profiler = StepProfiler(
+                os.path.join(args.output_dir, "profile"),
+                start_step=args.profile_start_step,
+                num_steps=args.profile_num_steps,
+            )
 
     # -------------------------------------------------------------- resume
 
@@ -167,6 +181,8 @@ class Trainer:
                 if epoch > 0:
                     sampler.set_epoch(epoch)
             for batch in self.train_data:
+                if self._profiler is not None:
+                    self._profiler.maybe_start(self.global_step)
                 t0 = time.time_ns()
                 rng = jax.random.fold_in(
                     jax.random.key(args.seed), self.global_step
@@ -175,6 +191,8 @@ class Trainer:
                     self.state, batch, rng
                 )
                 self.global_step += 1
+                if self._profiler is not None:
+                    self._profiler.maybe_stop(self.global_step - 1)
                 if self._timer is not None:
                     self._timer.record(
                         Tag.STEP, t0, time.time_ns() - t0
@@ -246,5 +264,7 @@ class Trainer:
         return loss
 
     def close(self):
+        if self._profiler is not None:
+            self._profiler.close()
         if self._engine is not None:
             self._engine.close()

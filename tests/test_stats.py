@@ -74,7 +74,11 @@ class TestJobMetricCollector:
         master = DistributedJobMaster(
             0, new_job_args("local", "stats-job", node_num=1)
         )
-        assert master.servicer.job_metric_collector is \
-            master.metric_collector
-        master.metric_collector.collect_runtime_once()
-        assert master.metric_collector.local_reporter.latest() is not None
+        try:
+            assert master.servicer.job_metric_collector is \
+                master.metric_collector
+            master.metric_collector.collect_runtime_once()
+            assert master.metric_collector.local_reporter.latest() \
+                is not None
+        finally:
+            master.stop()
