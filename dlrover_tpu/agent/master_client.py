@@ -212,6 +212,14 @@ class MasterClient:
 
     # -------------------------------------------------------------- config
 
+    def report_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           waiting_timeout: float = 30.0,
+                           node_unit: int = 1) -> bool:
+        return self._report(msg.RdzvParamsReport(
+            min_nodes=min_nodes, max_nodes=max_nodes,
+            waiting_timeout=waiting_timeout, node_unit=node_unit,
+        ))
+
     def feed_streaming_dataset(self, dataset_name: str, count: int,
                                end: bool = False) -> bool:
         return self._report(msg.StreamingFeed(

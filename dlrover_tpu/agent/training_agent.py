@@ -55,6 +55,9 @@ class ElasticLaunchConfig:
     max_restarts: int = 3
     monitor_interval: float = JobConstant.TRAINING_AGENT_LOOP_INTERVAL
     rdzv_timeout: float = JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT
+    # elastic (--nnodes lo:hi): how long the master waits for more
+    # nodes beyond min before forming the world
+    rdzv_elastic_wait: float = 30.0
     network_check: bool = False
     comm_perf_test: bool = False
     node_unit: int = 1
@@ -539,6 +542,14 @@ def launch_agent(
     client = MasterClient(
         master_addr, config.node_rank, "worker"
     )
+    if config.min_nodes != config.max_nodes:
+        # elastic --nnodes lo:hi: the master must form the world at
+        # >= min after the waiting window instead of insisting on max
+        client.report_rdzv_params(
+            config.min_nodes, config.max_nodes,
+            waiting_timeout=config.rdzv_elastic_wait,
+            node_unit=config.node_unit,
+        )
     if config.network_check:
         checker = NodeCheckElasticAgent(config, client)
         if not checker.run():

@@ -321,6 +321,16 @@ class ParallelConfig(Message):
 
 
 @dataclass
+class RdzvParamsReport(Message):
+    """Agent-side rendezvous parameters (--nnodes lo:hi elasticity)."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    waiting_timeout: float = 30.0
+    node_unit: int = 1
+
+
+@dataclass
 class StreamingFeed(Message):
     """Producer reports new records (or end) of a streaming dataset."""
 

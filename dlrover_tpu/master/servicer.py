@@ -177,6 +177,20 @@ class MasterServicer(RpcService):
     # --------------------------------------------------------------- report
 
     def report(self, node_type: str, node_id: int, message) -> bool:
+        if isinstance(message, msg.RdzvParamsReport):
+            for mgr in self.rdzv_managers.values():
+                mgr.update_rdzv_params(
+                    min_nodes=message.min_nodes,
+                    max_nodes=message.max_nodes,
+                    waiting_timeout=message.waiting_timeout,
+                    node_unit=message.node_unit,
+                )
+            logger.info(
+                "rendezvous params updated: min=%d max=%d wait=%.0fs "
+                "unit=%d", message.min_nodes, message.max_nodes,
+                message.waiting_timeout, message.node_unit,
+            )
+            return True
         if isinstance(message, msg.StreamingFeed):
             return self.task_manager.feed_streaming_dataset(
                 message.dataset_name, message.count, message.end

@@ -43,6 +43,9 @@ def trace(log_dir: str):
     try:
         yield log_dir
     finally:
+        # async dispatch: anything still in flight would be cut out of
+        # the device timeline
+        jax.effects_barrier()
         jax.profiler.stop_trace()
         logger.info("profile trace written to %s", log_dir)
 
@@ -75,11 +78,17 @@ class StepProfiler:
         logger.info("profiling steps [%d, %d) -> %s",
                     self.start_step, self.stop_step, self.log_dir)
 
-    def maybe_stop(self, step: int):
+    def maybe_stop(self, step: int, block_on=None):
+        """``block_on``: outputs of the last profiled step; they are
+        block_until_ready'd before the trace stops so async dispatch
+        doesn't truncate the device timeline (on TPU, Python runs ahead
+        of the device)."""
         if not self._active or step < self.stop_step - 1:
             return
         import jax
 
+        if block_on is not None:
+            jax.block_until_ready(block_on)
         jax.profiler.stop_trace()
         self._active = False
         self._done = True
@@ -87,8 +96,4 @@ class StepProfiler:
 
     def close(self):
         if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+            self.maybe_stop(self.stop_step)

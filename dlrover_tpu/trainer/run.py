@@ -56,6 +56,11 @@ def parse_args(argv=None):
     parser.add_argument("--save-at-breakpoint", action="store_true")
     parser.add_argument("--accelerator", type=str, default="tpu")
     parser.add_argument("--rdzv-timeout", type=float, default=600)
+    parser.add_argument(
+        "--rdzv-elastic-wait", type=float, default=30,
+        help="with --nnodes lo:hi, how long to wait for nodes beyond "
+             "min before forming the world",
+    )
     parser.add_argument("--log-dir", type=str, default=None)
     parser.add_argument("training_script", type=str)
     parser.add_argument(
@@ -140,6 +145,7 @@ def run(args) -> int:
         save_at_breakpoint=args.save_at_breakpoint,
         accelerator=args.accelerator,
         rdzv_timeout=args.rdzv_timeout,
+        rdzv_elastic_wait=args.rdzv_elastic_wait,
         log_dir=args.log_dir,
     )
     script_args = list(args.training_script_args)

@@ -192,7 +192,9 @@ class Trainer:
                 )
                 self.global_step += 1
                 if self._profiler is not None:
-                    self._profiler.maybe_stop(self.global_step - 1)
+                    self._profiler.maybe_stop(
+                        self.global_step - 1, block_on=metrics
+                    )
                 if self._timer is not None:
                     self._timer.record(
                         Tag.STEP, t0, time.time_ns() - t0

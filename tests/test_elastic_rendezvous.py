@@ -1,0 +1,53 @@
+"""Elastic rendezvous: --nnodes lo:hi forms the world at >= min nodes
+after the waiting window when max never shows up — reference elastic
+semantics (min/max rendezvous, rdzv_manager.py).
+"""
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training_agent import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    WorkerSpec,
+)
+from dlrover_tpu.common.constants import NodeType
+
+
+def test_forms_at_min_when_max_absent(local_master_2nodes, tmp_path):
+    """Master configured for 2 nodes; only one agent shows up with
+    --nnodes 1:2 — the agent's elastic params override the master's and
+    the world forms with a single node after the wait window."""
+    master = local_master_2nodes
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({'world': os.environ['WORLD_SIZE']}))\n"
+    )
+    config = ElasticLaunchConfig(
+        min_nodes=1,
+        max_nodes=2,
+        nproc_per_node=1,
+        monitor_interval=0.3,
+        rdzv_timeout=60,
+        rdzv_elastic_wait=1.0,
+        log_dir=str(tmp_path),
+    )
+    client = MasterClient(master.addr, 0, NodeType.WORKER)
+    # what launch_agent does for elastic configs
+    assert client.report_rdzv_params(
+        config.min_nodes, config.max_nodes,
+        waiting_timeout=config.rdzv_elastic_wait,
+    )
+    agent = ElasticTrainingAgent(
+        config, WorkerSpec(str(script), (), config), client
+    )
+    try:
+        assert agent.run() == 0
+    finally:
+        client.close()
+    import json
+    import os
+
+    logs = [p for p in os.listdir(tmp_path) if p.endswith(".log")]
+    assert logs
+    data = json.loads((tmp_path / logs[0]).read_text().strip())
+    assert data["world"] == "1"
