@@ -337,14 +337,19 @@ class AsyncCheckpointSaver:
         if cls._factory_thread is not None:
             return
         factory_queue = SharedQueue(SAVER_FACTORY_QUEUE, create=True)
+        cls._factory_queue = factory_queue
+        stop = threading.Event()
+        cls._factory_stop = stop
 
         def factory_loop():
-            while True:
+            while not stop.is_set():
                 try:
                     config = factory_queue.get(timeout=60)
                 except _queue.Empty:
                     continue
                 except Exception:  # noqa: BLE001
+                    if stop.is_set():
+                        return
                     time.sleep(1)
                     continue
                 try:
@@ -368,6 +373,22 @@ class AsyncCheckpointSaver:
         if cls._saver_instance is not None:
             cls._saver_instance.stop()
             cls._saver_instance = None
+        # also retire the factory listener: a stale thread bound to a
+        # previous socket dir would make the next start_async_saving_ckpt
+        # a silent no-op (its queue socket no longer matches the env)
+        if cls._factory_thread is not None:
+            stop = getattr(cls, "_factory_stop", None)
+            if stop is not None:
+                stop.set()
+            queue_obj = getattr(cls, "_factory_queue", None)
+            if queue_obj is not None:
+                try:
+                    queue_obj.unlink()
+                except Exception:  # noqa: BLE001
+                    pass
+            cls._factory_thread = None
+            cls._factory_queue = None
+            cls._factory_stop = None
 
     # -- event loop --------------------------------------------------------
 

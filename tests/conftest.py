@@ -75,11 +75,16 @@ def _reset_global_mesh():
 def isolated_ckpt_env(tmp_path, monkeypatch):
     """Job-scoped socket dir + shm + saver-singleton isolation shared by
     the flash-checkpoint / trainer / chaos test files."""
+    from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
     monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
     job = f"iso{os.getpid()}"
     monkeypatch.setenv("ELASTIC_JOB_NAME", job)
+    # clear any saver/factory a PREVIOUS test left behind (tests that
+    # run agents without this fixture leave a factory thread bound to
+    # their socket dir, which would make this test's saver a no-op)
+    AsyncCheckpointSaver.reset()
     yield job
-    from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
     from dlrover_tpu.common.ipc import PersistentSharedMemory
 
     AsyncCheckpointSaver.reset()

@@ -246,3 +246,94 @@ class TestReviewFixes:
         with pytest.raises(ValueError, match="refusing"):
             engine.load(target={"w": jnp.zeros((8, 8))})
         engine.close()
+
+
+class TestAsyncSave:
+    def test_async_save_matches_sync(self, tmp_path):
+        """save_to_memory_async must produce the same restorable state
+        as the blocking save (the bench's headline path)."""
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        state = make_state(seed=5)
+        assert engine.save_to_memory_async(11, state)
+        assert engine.wait_for_shm_save(timeout=30)
+        restored = engine.load()
+        assert restored["step"] == 11
+        flat = restored["state"]
+        want = {
+            jax.tree_util.keystr(kp): leaf
+            for kp, leaf in
+            jax.tree_util.tree_flatten_with_path(state)[0]
+        }
+        for name, arr in flat.items():
+            np.testing.assert_allclose(
+                np.asarray(arr), np.asarray(want[name]), rtol=1e-6
+            )
+        engine.close()
+
+    def test_second_async_save_skipped_while_busy(self, tmp_path):
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        state = make_state()
+        assert engine.save_to_memory_async(1, state)
+        assert engine.wait_for_shm_save(timeout=30)
+        # force the busy branch by holding the shm lock ourselves
+        assert engine._shm_lock.acquire(blocking=False)
+        try:
+            assert not engine.save_to_memory_async(2, state)
+        finally:
+            engine._shm_lock.release()
+        engine.close()
+
+    def test_async_then_sync_sequence(self, tmp_path):
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        s1, s2 = make_state(seed=1), make_state(seed=2)
+        assert engine.save_to_memory_async(1, s1)
+        assert engine.wait_for_shm_save(timeout=30)
+        assert engine.save_to_memory(2, s2)
+        assert engine.load()["step"] == 2
+        engine.close()
+
+
+class TestSaveAtBreakpoint:
+    def test_agent_flushes_shm_on_worker_failure(
+        self, tmp_path, local_master
+    ):
+        """Worker writes a shm checkpoint then dies with no retries
+        left; --save-at-breakpoint flushes it to storage before the
+        agent gives up (reference _save_ckpt_to_storage :589)."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.agent.training_agent import (
+            ElasticLaunchConfig,
+            ElasticTrainingAgent,
+            WorkerSpec,
+        )
+        from dlrover_tpu.common.constants import NodeType
+
+        ckpt_dir = tmp_path / "bp_ckpt"
+        script = tmp_path / "bp.py"
+        script.write_text(
+            "import os\n"
+            "import jax.numpy as jnp\n"
+            "from dlrover_tpu.trainer.flash_checkpoint.engine import ("
+            "ReplicatedCheckpointEngine)\n"
+            f"e = ReplicatedCheckpointEngine({str(ckpt_dir)!r})\n"
+            "e.save_to_memory(7, {'w': jnp.ones((4,))})\n"
+            "os._exit(3)\n"
+        )
+        config = ElasticLaunchConfig(
+            min_nodes=1, max_nodes=1, nproc_per_node=1,
+            monitor_interval=0.3, rdzv_timeout=30, max_restarts=0,
+            save_at_breakpoint=True, log_dir=str(tmp_path),
+        )
+        client = MasterClient(local_master.addr, 0, NodeType.WORKER)
+        agent = ElasticTrainingAgent(
+            config, WorkerSpec(str(script), (), config), client
+        )
+        try:
+            assert agent.run() != 0  # worker failed for real
+        finally:
+            client.close()
+        # the shm image must have been flushed to storage
+        step_dirs = list(ckpt_dir.glob("checkpoint-7"))
+        assert step_dirs, list(ckpt_dir.glob("*"))
+        shards = list(step_dirs[0].glob("*.dlck"))
+        assert shards
