@@ -269,13 +269,41 @@ class AsyncCheckpointSaver:
         num_hosts: int = 1,
         master_client=None,
         storage=None,
+        deletion_strategy=None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.local_shard_num = local_shard_num
         self.host_rank = host_rank
         self.num_hosts = num_hosts
         self._master_client = master_client
-        self._storage = storage or PosixDiskStorage()
+        # Retention (reference KeepStepIntervalStrategy/
+        # KeepLatestStepStrategy): applied through the storage's commit
+        # hook so non-POSIX backends stay in charge of their own
+        # deletion. None = keep everything; env
+        # DLROVER_TPU_MAX_CKPTS_TO_KEEP=<n> selects keep-latest-n.
+        if deletion_strategy is None and storage is None:
+            raw = os.environ.get("DLROVER_TPU_MAX_CKPTS_TO_KEEP", "")
+            try:
+                keep = int(raw or 0)
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed DLROVER_TPU_MAX_CKPTS_TO_KEEP=%r",
+                    raw,
+                )
+                keep = 0
+            if keep > 0 and checkpoint_dir:
+                from dlrover_tpu.common.storage import (
+                    KeepLatestStepStrategy,
+                )
+
+                deletion_strategy = KeepLatestStepStrategy(
+                    keep, checkpoint_dir
+                )
+        if storage is None:
+            storage = PosixDiskStorage(
+                deletion_strategy=deletion_strategy
+            )
+        self._storage = storage
         self._shm_handlers = [
             SharedMemoryHandler(i) for i in range(local_shard_num)
         ]

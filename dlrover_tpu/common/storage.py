@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 from abc import ABC, abstractmethod
 
 from dlrover_tpu.common.constants import CheckpointConstant
@@ -24,51 +25,83 @@ class CheckpointDeletionStrategy(ABC):
         committed; call ``delete_func(dir)`` for each."""
 
 
+def _step_dir(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(
+        checkpoint_dir, f"{CheckpointConstant.STEP_DIR_PREFIX}{step}"
+    )
+
+
+def _existing_steps(checkpoint_dir: str) -> list[int]:
+    """Step dirs already on disk (restart survivors must be counted)."""
+    prefix = CheckpointConstant.STEP_DIR_PREFIX
+    steps = []
+    try:
+        for name in os.listdir(checkpoint_dir):
+            if name.startswith(prefix):
+                try:
+                    steps.append(int(name[len(prefix):]))
+                except ValueError:
+                    pass
+    except FileNotFoundError:
+        pass
+    return sorted(steps)
+
+
 class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
-    """Keep only checkpoints whose step is a multiple of ``keep_interval``."""
+    """Keep only checkpoints whose step is a multiple of
+    ``keep_interval``. Thread-safe and idempotent: commit may run once
+    per shard thread for the same step."""
 
     def __init__(self, keep_interval: int, checkpoint_dir: str):
         self._keep_interval = keep_interval
         self._checkpoint_dir = checkpoint_dir
-        self._steps_to_clean: list[int] = []
+        self._lock = threading.Lock()
+        self._cleaned: set[int] = set()
 
     def clean_up(self, step: int, delete_func):
-        if step % self._keep_interval == 0:
-            return
-        self._steps_to_clean.append(step)
-        while self._steps_to_clean:
-            rm_step = self._steps_to_clean.pop()
-            path = os.path.join(
-                self._checkpoint_dir,
-                f"{CheckpointConstant.STEP_DIR_PREFIX}{rm_step}",
-            )
-            try:
-                delete_func(path)
-            except Exception as e:  # noqa: BLE001
-                logger.warning(f"fail to clean {path}: {e}")
+        with self._lock:
+            candidates = [
+                s for s in _existing_steps(self._checkpoint_dir)
+                if s % self._keep_interval != 0
+                and s != step  # never the just-committed step
+                and s not in self._cleaned
+            ]
+            for rm_step in candidates:
+                path = _step_dir(self._checkpoint_dir, rm_step)
+                try:
+                    delete_func(path)
+                    self._cleaned.add(rm_step)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(f"fail to clean {path}: {e}")
 
 
 class KeepLatestStepStrategy(CheckpointDeletionStrategy):
-    """Keep at most ``max_to_keep`` newest step dirs."""
+    """Keep at most ``max_to_keep`` newest step dirs.
+
+    Thread-safe and idempotent: the set of steps is re-derived from the
+    directories actually on disk, so repeated commits of one step (one
+    per shard thread), custom-path saves outside checkpoint_dir, and
+    dirs surviving an agent restart are all accounted correctly."""
 
     def __init__(self, max_to_keep: int, checkpoint_dir: str):
         self._max_to_keep = max(max_to_keep, 1)
         self._checkpoint_dir = checkpoint_dir
-        self._steps: list[int] = []
+        self._lock = threading.Lock()
 
     def clean_up(self, step: int, delete_func):
-        self._steps.append(step)
-        self._steps.sort()
-        while len(self._steps) > self._max_to_keep:
-            rm_step = self._steps.pop(0)
-            path = os.path.join(
-                self._checkpoint_dir,
-                f"{CheckpointConstant.STEP_DIR_PREFIX}{rm_step}",
-            )
-            try:
-                delete_func(path)
-            except Exception as e:  # noqa: BLE001
-                logger.warning(f"fail to clean {path}: {e}")
+        with self._lock:
+            steps = _existing_steps(self._checkpoint_dir)
+            # the just-committed step is protected even if its dir isn't
+            # visible yet (object stores with eventual listing)
+            victims = [s for s in steps if s != step]
+            keep = self._max_to_keep - 1  # slot reserved for ``step``
+            excess = victims[: max(len(victims) - keep, 0)]
+            for rm_step in excess:
+                path = _step_dir(self._checkpoint_dir, rm_step)
+                try:
+                    delete_func(path)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(f"fail to clean {path}: {e}")
 
 
 class CheckpointStorage(ABC):

@@ -337,3 +337,58 @@ class TestSaveAtBreakpoint:
         assert step_dirs, list(ckpt_dir.glob("*"))
         shards = list(step_dirs[0].glob("*.dlck"))
         assert shards
+
+
+class TestDeletionStrategy:
+    def test_keep_latest_n(self, tmp_path, monkeypatch):
+        """DLROVER_TPU_MAX_CKPTS_TO_KEEP retains only the newest dirs
+        (reference KeepLatestStepStrategy, common/storage.py)."""
+        monkeypatch.setenv("DLROVER_TPU_MAX_CKPTS_TO_KEEP", "2")
+        ckpt_dir = str(tmp_path / "ckpt")
+        engine = ReplicatedCheckpointEngine(ckpt_dir)
+        for step in (1, 2, 3, 4):
+            state = make_state(seed=step)
+            assert engine.save_to_memory(step, state)
+            assert engine.save_to_storage(step, state)
+            assert engine.wait_for_persist(step, timeout=60)
+        import os as _os
+
+        dirs = sorted(
+            d for d in _os.listdir(ckpt_dir)
+            if d.startswith("checkpoint-")
+        )
+        assert dirs == ["checkpoint-3", "checkpoint-4"], dirs
+        # tracker still points at the newest
+        assert engine.latest_step() == 4
+        engine.close()
+
+    def test_restart_counts_existing_dirs(self, tmp_path):
+        """Dirs surviving an agent restart are retired by a fresh
+        strategy instance (state derived from disk, not memory)."""
+        from dlrover_tpu.common.storage import KeepLatestStepStrategy
+
+        ckpt_dir = tmp_path / "ckpt"
+        for step in (1, 2, 3):
+            (ckpt_dir / f"checkpoint-{step}").mkdir(parents=True)
+        strat = KeepLatestStepStrategy(2, str(ckpt_dir))
+        import shutil as _shutil
+
+        strat.clean_up(4, lambda p: _shutil.rmtree(p))
+        left = sorted(p.name for p in ckpt_dir.iterdir())
+        assert left == ["checkpoint-3"]  # 4's slot reserved, 3 kept
+
+    def test_repeated_commit_same_step_idempotent(self, tmp_path):
+        from dlrover_tpu.common.storage import KeepLatestStepStrategy
+
+        ckpt_dir = tmp_path / "ckpt"
+        for step in (7, 8):
+            (ckpt_dir / f"checkpoint-{step}").mkdir(parents=True)
+        strat = KeepLatestStepStrategy(2, str(ckpt_dir))
+        import shutil as _shutil
+
+        for _ in range(4):  # one call per shard thread
+            strat.clean_up(8, lambda p: _shutil.rmtree(p))
+        left = sorted(p.name for p in ckpt_dir.iterdir())
+        # the just-committed step is never deleted; 7 fills the one
+        # remaining slot
+        assert left == ["checkpoint-7", "checkpoint-8"]
