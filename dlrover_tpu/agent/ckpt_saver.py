@@ -300,9 +300,19 @@ class AsyncCheckpointSaver:
                     keep, checkpoint_dir
                 )
         if storage is None:
-            storage = PosixDiskStorage(
-                deletion_strategy=deletion_strategy
-            )
+            from dlrover_tpu.common.storage import get_checkpoint_storage
+
+            storage = get_checkpoint_storage(deletion_strategy)
+        elif deletion_strategy is not None:
+            # attach the caller's policy to their storage when possible;
+            # never silently drop an explicit retention request
+            if getattr(storage, "_deletion_strategy", "absent") is None:
+                storage._deletion_strategy = deletion_strategy
+            else:
+                logger.warning(
+                    "deletion_strategy ignored: the provided storage "
+                    "already manages retention"
+                )
         self._storage = storage
         self._shm_handlers = [
             SharedMemoryHandler(i) for i in range(local_shard_num)
@@ -587,7 +597,13 @@ class AsyncCheckpointSaver:
                     CheckpointConstant.TRACKER_FILE,
                 ),
             )
-            self._storage.commit(step, True)
+            # retention must only run for steps committed under
+            # checkpoint_dir: a custom event.path outside it would
+            # otherwise evict the tracker's target dir
+            if os.path.dirname(step_dir) == self.checkpoint_dir.rstrip(
+                "/"
+            ):
+                self._storage.commit(step, True)
         self._last_persisted_step = step
 
     def _finalize_step_dir(self, step_dir: str):

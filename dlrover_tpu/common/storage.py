@@ -56,21 +56,21 @@ class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
         self._keep_interval = keep_interval
         self._checkpoint_dir = checkpoint_dir
         self._lock = threading.Lock()
-        self._cleaned: set[int] = set()
 
     def clean_up(self, step: int, delete_func):
         with self._lock:
+            # no memo of past deletions: after a rollback resume the
+            # same step numbers can legitimately reappear and must be
+            # cleanable again; disk state is the only source of truth
             candidates = [
                 s for s in _existing_steps(self._checkpoint_dir)
                 if s % self._keep_interval != 0
-                and s != step  # never the just-committed step
-                and s not in self._cleaned
+                and s < step  # never the just-committed or newer steps
             ]
             for rm_step in candidates:
                 path = _step_dir(self._checkpoint_dir, rm_step)
                 try:
                     delete_func(path)
-                    self._cleaned.add(rm_step)
                 except Exception as e:  # noqa: BLE001
                     logger.warning(f"fail to clean {path}: {e}")
 
@@ -91,11 +91,13 @@ class KeepLatestStepStrategy(CheckpointDeletionStrategy):
     def clean_up(self, step: int, delete_func):
         with self._lock:
             steps = _existing_steps(self._checkpoint_dir)
-            # the just-committed step is protected even if its dir isn't
-            # visible yet (object stores with eventual listing)
-            victims = [s for s in steps if s != step]
-            keep = self._max_to_keep - 1  # slot reserved for ``step``
-            excess = victims[: max(len(victims) - keep, 0)]
+            # protect the just-committed step AND anything newer: a
+            # lagging shard thread may commit step N after N+1 already
+            # landed, and must never delete the tracker's target
+            protected = {s for s in steps if s >= step} | {step}
+            victims = [s for s in steps if s < step]
+            keep_slots = max(self._max_to_keep - len(protected), 0)
+            excess = victims[: max(len(victims) - keep_slots, 0)]
             for rm_step in excess:
                 path = _step_dir(self._checkpoint_dir, rm_step)
                 try:

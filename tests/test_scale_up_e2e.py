@@ -1,0 +1,117 @@
+"""Scale-UP e2e: a job running at min nodes adopts a late joiner.
+
+Agent A forms a world of 1 (elastic --nnodes 1:2); agent B joins later;
+A's monitor sees the membership change, restarts its worker, and both
+workers re-rendezvous into a world of 2 — the reference's membership-
+change restart (training.py:602-606) end to end.
+"""
+
+import json
+import threading
+import time
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training_agent import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    WorkerSpec,
+)
+from dlrover_tpu.common.constants import NodeType
+
+WORKER = """
+import json, os, sys, time
+world = int(os.environ["WORLD_SIZE"])
+out = os.environ["SCALE_OUT_DIR"]
+rank = os.environ["RANK"]
+with open(f"{out}/world_{rank}_{os.getpid()}.json", "w") as f:
+    json.dump({"world": world, "rank": rank}, f)
+if world < 2:
+    # first incarnation: keep training until the restart takes us down
+    time.sleep(600)
+sys.exit(0)
+"""
+
+
+def _make_agent(master, rank, tmp_path, monkeypatch):
+    config = ElasticLaunchConfig(
+        min_nodes=1,
+        max_nodes=2,
+        nproc_per_node=1,
+        node_rank=rank,
+        monitor_interval=0.3,
+        rdzv_timeout=60,
+        rdzv_elastic_wait=1.0,
+        max_restarts=3,
+        log_dir=str(tmp_path / f"logs{rank}"),
+    )
+    (tmp_path / f"logs{rank}").mkdir(exist_ok=True)
+    client = MasterClient(master.addr, rank, NodeType.WORKER)
+    script = tmp_path / "scale_worker.py"
+    if not script.exists():
+        script.write_text(WORKER)
+    agent = ElasticTrainingAgent(
+        config, WorkerSpec(str(script), (), config), client
+    )
+    return agent, client, config
+
+
+def test_late_joiner_triggers_world_growth(
+    local_master_2nodes, tmp_path, monkeypatch
+):
+    master = local_master_2nodes
+    monkeypatch.setenv("SCALE_OUT_DIR", str(tmp_path))
+
+    agent_a, client_a, config = _make_agent(
+        master, 0, tmp_path, monkeypatch
+    )
+    # elastic params: form at >=1 after 1s instead of insisting on 2
+    assert client_a.report_rdzv_params(
+        config.min_nodes, config.max_nodes,
+        waiting_timeout=config.rdzv_elastic_wait,
+    )
+
+    results = {}
+
+    def run_a():
+        results["a"] = agent_a.run()
+
+    ta = threading.Thread(target=run_a, daemon=True)
+    ta.start()
+
+    # wait until A's first worker reports a world of 1
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        singles = [
+            p for p in tmp_path.glob("world_0_*.json")
+            if json.loads(p.read_text())["world"] == 1
+        ]
+        if singles:
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("worker never formed the 1-node world")
+
+    # late joiner
+    agent_b, client_b, _ = _make_agent(master, 1, tmp_path, monkeypatch)
+
+    def run_b():
+        results["b"] = agent_b.run()
+
+    tb = threading.Thread(target=run_b, daemon=True)
+    tb.start()
+
+    ta.join(timeout=120)
+    tb.join(timeout=120)
+    client_a.close()
+    client_b.close()
+    assert results.get("a") == 0, results
+    assert results.get("b") == 0, results
+
+    # both final workers saw a 2-node world
+    worlds = [
+        json.loads(p.read_text())
+        for p in tmp_path.glob("world_*.json")
+    ]
+    grown = [w for w in worlds if w["world"] == 2]
+    ranks = {w["rank"] for w in grown}
+    assert ranks == {"0", "1"}, worlds
