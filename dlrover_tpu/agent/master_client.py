@@ -239,6 +239,9 @@ class MasterClient:
     def get_paral_config(self) -> msg.ParallelConfig:
         return self._get(msg.ParallelConfigRequest())
 
+    def report_elastic_run_config(self, configs: dict) -> bool:
+        return self._report(msg.ElasticRunConfig(configs=configs))
+
     def get_elastic_run_config(self) -> dict:
         res: msg.ElasticRunConfig = self._get(msg.ElasticRunConfigRequest())
         return res.configs if res else {}

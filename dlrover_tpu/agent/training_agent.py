@@ -531,6 +531,45 @@ class NodeCheckElasticAgent:
         return True
 
 
+_SHARED_CONFIG_KEYS = ("nproc_per_node", "network_check", "node_unit")
+
+
+def _share_run_config(client: MasterClient, config: ElasticLaunchConfig,
+                      wait: float = 30.0):
+    """Flag consistency across hosts (reference auto_config sharing).
+
+    Rank 0 publishes the launch flags that must match job-wide; later
+    joiners poll for them (all hosts start concurrently, so a single
+    fetch would race rank 0's publish) and adopt, so a fat-fingered
+    per-host flag can't split the rendezvous world.
+    """
+    if config.node_rank == 0:
+        client.report_elastic_run_config({
+            k: getattr(config, k) for k in _SHARED_CONFIG_KEYS
+        })
+        return
+    deadline = time.time() + wait
+    published: dict = {}
+    while time.time() < deadline:
+        published = client.get_elastic_run_config()
+        if published:
+            break
+        time.sleep(0.5)
+    if not published:
+        logger.warning(
+            "rank 0 never published a run config within %.0fs; keeping "
+            "local flags", wait,
+        )
+        return
+    for key in _SHARED_CONFIG_KEYS:
+        if key in published and published[key] != getattr(config, key):
+            logger.warning(
+                "adopting job-wide %s=%r (was %r)",
+                key, published[key], getattr(config, key),
+            )
+            setattr(config, key, published[key])
+
+
 def launch_agent(
     config: ElasticLaunchConfig,
     entrypoint: str,
@@ -542,6 +581,7 @@ def launch_agent(
     client = MasterClient(
         master_addr, config.node_rank, "worker"
     )
+    _share_run_config(client, config)
     if config.min_nodes != config.max_nodes:
         # elastic --nnodes lo:hi: the master must form the world at
         # >= min after the waiting window instead of insisting on max

@@ -177,6 +177,9 @@ class MasterServicer(RpcService):
     # --------------------------------------------------------------- report
 
     def report(self, node_type: str, node_id: int, message) -> bool:
+        if isinstance(message, msg.ElasticRunConfig):
+            self.set_run_configs(message.configs)
+            return True
         if isinstance(message, msg.RdzvParamsReport):
             for mgr in self.rdzv_managers.values():
                 mgr.update_rdzv_params(

@@ -203,3 +203,44 @@ class TestNodeCheck:
             assert checker.run()
         finally:
             client.close()
+
+
+class TestRunConfigSharing:
+    def test_late_joiner_adopts_rank0_flags(self, local_master):
+        """Rank 0 publishes launch flags; a MISCONFIGURED later joiner's
+        config object is rewritten by the adoption logic itself."""
+        from dlrover_tpu.agent.training_agent import (
+            ElasticLaunchConfig,
+            _share_run_config,
+        )
+
+        client0 = make_client(local_master, 0)
+        rank0_cfg = ElasticLaunchConfig(
+            node_rank=0, nproc_per_node=4, network_check=True,
+            node_unit=2,
+        )
+        _share_run_config(client0, rank0_cfg)
+
+        client1 = make_client(local_master, 1)
+        fat_fingered = ElasticLaunchConfig(
+            node_rank=1, nproc_per_node=8, network_check=False,
+            node_unit=1,
+        )
+        _share_run_config(client1, fat_fingered, wait=10)
+        assert fat_fingered.nproc_per_node == 4
+        assert fat_fingered.network_check is True
+        assert fat_fingered.node_unit == 2
+        client0.close()
+        client1.close()
+
+    def test_unpublished_config_keeps_local_flags(self, local_master):
+        from dlrover_tpu.agent.training_agent import (
+            ElasticLaunchConfig,
+            _share_run_config,
+        )
+
+        client = make_client(local_master, 1)
+        cfg = ElasticLaunchConfig(node_rank=1, nproc_per_node=3)
+        _share_run_config(client, cfg, wait=1.0)
+        assert cfg.nproc_per_node == 3
+        client.close()
