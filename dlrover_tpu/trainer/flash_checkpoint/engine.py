@@ -52,13 +52,60 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger(__name__)
 
 
+def _path_entry_str(entry) -> str:
+    # dotted names ("params.w" not "['params']['w']"): stable across
+    # jax versions and readable in metas/logs
+    import jax
+
+    if isinstance(entry, jax.tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, jax.tree_util.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, jax.tree_util.GetAttrKey):
+        return str(entry.name)
+    if isinstance(entry, jax.tree_util.FlattenedIndexKey):
+        return str(entry.key)
+    return jax.tree_util.keystr((entry,))
+
+
 def _tree_flatten_with_names(tree):
     import jax
 
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    names = [jax.tree_util.keystr(path) for path, _ in leaves_with_paths]
+    names = [
+        ".".join(_path_entry_str(e) for e in path) or "leaf"
+        for path, _ in leaves_with_paths
+    ]
+    if len(set(names)) != len(names):
+        # pathological keys (a dict key containing '.') can make dotted
+        # names collide; fall back to the collision-free keystr form for
+        # the whole tree rather than merging distinct leaves
+        names = [
+            jax.tree_util.keystr(path) for path, _ in leaves_with_paths
+        ]
     leaves = [leaf for _, leaf in leaves_with_paths]
     return names, leaves, treedef
+
+
+_LEGACY_NAME_RE = None
+
+
+def _legacy_to_dotted(name: str) -> str:
+    """Translate pre-dotted keystr names ("['a']['b']", "[0]") so
+    checkpoints written by older builds keep restoring. Names that are
+    not entirely bracket-form are returned unchanged."""
+    global _LEGACY_NAME_RE
+    if _LEGACY_NAME_RE is None:
+        import re
+
+        _LEGACY_NAME_RE = re.compile(r"\[(?:'([^']*)'|(\d+))\]")
+    matches = list(_LEGACY_NAME_RE.finditer(name))
+    if not matches or "".join(m.group(0) for m in matches) != name:
+        return name
+    return ".".join(
+        m.group(1) if m.group(1) is not None else m.group(2)
+        for m in matches
+    )
 
 
 def _unique_addressable_shards(arr):
@@ -429,7 +476,9 @@ class CheckpointEngine:
                 .reshape(leaf.shape)
                 .copy()
             )
-            leaf_map.setdefault(leaf.path, []).append((leaf, arr))
+            leaf_map.setdefault(
+                _legacy_to_dotted(leaf.path), []
+            ).append((leaf, arr))
         if target is not None:
             # This host's shm may legitimately hold only a subset of the
             # leaves (sharded engine dedups host-replicated leaves to one
@@ -473,7 +522,9 @@ class CheckpointEngine:
                     count=_count(leaf.shape),
                     offset=leaf.offset,
                 ).reshape(leaf.shape)
-                leaf_map.setdefault(leaf.path, []).append((leaf, arr))
+                leaf_map.setdefault(
+                    _legacy_to_dotted(leaf.path), []
+                ).append((leaf, arr))
         if not leaf_map:
             return None
         if not _covers_global(leaf_map):

@@ -206,9 +206,9 @@ class TestReviewFixes:
         s1 = {"w": jnp.ones((8,))}
         engine.save_to_memory(1, s1)
         restored = engine.load()
-        w_before = restored["state"]["['w']"].copy()
+        w_before = restored["state"]["w"].copy()
         engine.save_to_memory(2, {"w": jnp.full((8,), 9.0)})
-        assert np.allclose(restored["state"]["['w']"], w_before)
+        assert np.allclose(restored["state"]["w"], w_before)
         engine.close()
 
     def test_agent_handler_refresh_after_regrow(self, tmp_path):
@@ -259,11 +259,12 @@ class TestAsyncSave:
         restored = engine.load()
         assert restored["step"] == 11
         flat = restored["state"]
-        want = {
-            jax.tree_util.keystr(kp): leaf
-            for kp, leaf in
-            jax.tree_util.tree_flatten_with_path(state)[0]
-        }
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            _tree_flatten_with_names,
+        )
+
+        names, leaves, _ = _tree_flatten_with_names(state)
+        want = dict(zip(names, leaves))
         for name, arr in flat.items():
             np.testing.assert_allclose(
                 np.asarray(arr), np.asarray(want[name]), rtol=1e-6
@@ -392,3 +393,63 @@ class TestDeletionStrategy:
         # the just-committed step is never deleted; 7 fills the one
         # remaining slot
         assert left == ["checkpoint-7", "checkpoint-8"]
+
+
+class TestLeafNaming:
+    def test_dotted_names_literal(self):
+        """Literal expected names, independent of the naming function."""
+        import jax.numpy as _jnp
+
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            _tree_flatten_with_names,
+        )
+
+        tree = {"params": {"w": _jnp.zeros(2), "b": _jnp.zeros(1)},
+                "opt": [_jnp.zeros(3)]}
+        names, _, _ = _tree_flatten_with_names(tree)
+        assert set(names) == {"opt.0", "params.b", "params.w"}
+
+    def test_collision_falls_back_to_keystr(self):
+        import jax.numpy as _jnp
+
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            _tree_flatten_with_names,
+        )
+
+        tree = {"a": {"b": _jnp.zeros(1)}, "a.b": _jnp.zeros(2)}
+        names, _, _ = _tree_flatten_with_names(tree)
+        assert len(set(names)) == 2  # distinct leaves stay distinct
+
+    def test_legacy_checkpoint_restores(self, tmp_path):
+        """A shm image written with old keystr names restores into a
+        target via the legacy-name translation."""
+        import jax
+        import jax.numpy as _jnp
+
+        from dlrover_tpu.trainer.flash_checkpoint import engine as eng
+
+        e = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        state = {"params": {"w": _jnp.full((4,), 3.0)}}
+        # simulate an old-build writer: monkeypatch naming to keystr
+        real = eng._tree_flatten_with_names
+
+        def legacy_flatten(tree):
+            lw, td = jax.tree_util.tree_flatten_with_path(tree)
+            return (
+                [jax.tree_util.keystr(p) for p, _ in lw],
+                [l for _, l in lw],
+                td,
+            )
+
+        eng._tree_flatten_with_names = legacy_flatten
+        try:
+            assert e.save_to_memory(5, state)
+        finally:
+            eng._tree_flatten_with_names = real
+        target = {"params": {"w": _jnp.zeros((4,))}}
+        restored, step = e.load(target=target)
+        assert step == 5
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["w"]), 3.0
+        )
+        e.close()
