@@ -45,6 +45,11 @@ class LlamaConfig:
     dtype: str = "bfloat16"          # activation/compute dtype
     attn_impl: str = "flash"         # "flash" | "reference"
     remat: bool = True               # checkpoint each scanned layer
+    # checkpoint policy when remat=True: "dots_attn" saves weight
+    # matmuls AND the flash-attention output (the Pallas kernel is the
+    # costliest op to recompute); "dots_no_batch" saves weight matmuls
+    # only; "dots" additionally saves batched dots
+    remat_policy: str = "dots_attn"
     # measured on v5e (nano-350m, seq 2048): 1024x1024 beats 512x512 by
     # ~15% tokens/s; 2048-wide K blocks fail to fit VMEM
     attn_block_q: int = 1024
@@ -98,8 +103,10 @@ PRESETS = {
         mlp_dim=128, max_seq_len=128, attn_impl="reference", remat=False,
         dtype="float32",
     ),
+    # head_dim 128 (llama-standard): K=64 contractions cap the MXU at
+    # half utilisation, measured 2x slower attention kernels on v5e
     "nano-350m": LlamaConfig(
-        vocab_size=32000, dim=1024, n_layers=16, n_heads=16, n_kv_heads=16,
+        vocab_size=32000, dim=1024, n_layers=16, n_heads=8, n_kv_heads=8,
         mlp_dim=2816, max_seq_len=2048,
     ),
     "llama2-1b": LlamaConfig(
@@ -348,9 +355,19 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None,
         stage_layer_scan,
     )
 
+    policy = {
+        "dots_attn": jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        ),
+        "dots_no_batch":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots": jax.checkpoint_policies.dots_saveable,
+    }[config.remat_policy]
     stage_fn = stage_layer_scan(
         lambda h, lp, pos: _layer(config, h, lp, pos),
         remat=config.remat,
+        policy=policy,
     )
     if pipe_size() > 1:
         # layer stack sharded over the ``pipe`` axis: GPipe microbatch

@@ -83,6 +83,39 @@ def _compiler_params(dims):
 # ---------------------------------------------------------------------------
 
 
+def _needs_mask_static(causal, block_q, block_k, q_len, kv_len):
+    """Whether ANY block can need masking (padding is static)."""
+    return causal or q_len % block_q != 0 or kv_len % block_k != 0
+
+
+def _mask_needed(i, j, *, causal, block_q, block_k, q_len, kv_len):
+    """Dynamic predicate: this block contains masked positions — it
+    crosses the causal diagonal or is a padded edge block. Interior
+    blocks skip all mask VPU work."""
+    need = jnp.bool_(False)
+    if causal:
+        offset = kv_len - q_len
+        need = need | (j * block_k + (block_k - 1) > offset + i * block_q)
+    if q_len % block_q != 0:
+        need = need | (i == pl.cdiv(q_len, block_q) - 1)
+    if kv_len % block_k != 0:
+        need = need | (j == pl.cdiv(kv_len, block_k) - 1)
+    return need
+
+
+def _dispatch_tile(run, tile, i, j, *, causal, block_q, block_k, q_len,
+                   kv_len):
+    """Invoke ``tile(masked)`` under the ``run`` predicate, selecting the
+    mask-free variant for blocks that cannot contain masked positions."""
+    if _needs_mask_static(causal, block_q, block_k, q_len, kv_len):
+        need = _mask_needed(i, j, causal=causal, block_q=block_q,
+                            block_k=block_k, q_len=q_len, kv_len=kv_len)
+        pl.when(run & need)(lambda: tile(True))
+        pl.when(run & jnp.logical_not(need))(lambda: tile(False))
+    else:
+        pl.when(run)(lambda: tile(False))
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
@@ -100,18 +133,21 @@ def _fwd_kernel(
     offset = kv_len - q_len
     run = (j * block_k < offset + (i + 1) * block_q) if causal else (j >= 0)
 
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0]
+    def _tile(masked):
+        # sm_scale folded into the q tile: one [bq, d] multiply instead
+        # of a [bq, bk] multiply on the score matrix
+        q = q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype)
         k = _zero_pad_rows(k_ref[0, 0], j, block_k, kv_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale
-        mask = _block_mask(
-            s.shape, i, j, block_q=block_q, block_k=block_k,
-            causal=causal, q_len=q_len, kv_len=kv_len,
         )
+        mask = None
+        if masked:
+            mask = _block_mask(
+                s.shape, i, j, block_q=block_q, block_k=block_k,
+                causal=causal, q_len=q_len, kv_len=kv_len,
+            )
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:, :1]
@@ -132,6 +168,9 @@ def _fwd_kernel(
         acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    _dispatch_tile(run, _tile, i, j, causal=causal, block_q=block_q,
+                   block_k=block_k, q_len=q_len, kv_len=kv_len)
 
     @pl.when(j == num_kv_blocks - 1)
     def _final():
@@ -215,9 +254,10 @@ def _bwd_dq_kernel(
     offset = kv_len - q_len
     run = (j * block_k < offset + (i + 1) * block_q) if causal else (j >= 0)
 
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0]
+    def _tile(masked):
+        # scaled-q trick: s uses q*sm_scale; ds stays unscaled and the
+        # final dq is scaled once (dq = scale * ds @ k)
+        q = q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype)
         k = _zero_pad_rows(k_ref[0, 0], j, block_k, kv_len)
         v = _zero_pad_rows(v_ref[0, 0], j, block_k, kv_len)
         do = do_ref[0, 0]
@@ -226,11 +266,13 @@ def _bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale
-        mask = _block_mask(
-            s.shape, i, j, block_q=block_q, block_k=block_k,
-            causal=causal, q_len=q_len, kv_len=kv_len,
         )
+        mask = None
+        if masked:
+            mask = _block_mask(
+                s.shape, i, j, block_q=block_q, block_k=block_k,
+                causal=causal, q_len=q_len, kv_len=kv_len,
+            )
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -240,15 +282,18 @@ def _bwd_dq_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
+    _dispatch_tile(run, _tile, i, j, causal=causal, block_q=block_q,
+                   block_k=block_k, q_len=q_len, kv_len=kv_len)
+
     @pl.when(j == num_kv_blocks - 1)
     def _final():
-        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+        dq_ref[0, 0] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
@@ -267,9 +312,11 @@ def _bwd_dkv_kernel(
     offset = kv_len - q_len
     run = (offset + (i + 1) * block_q > j * block_k) if causal else (i >= 0)
 
-    @pl.when(run)
-    def _body():
+    def _tile(masked):
+        # scaled-q trick: the scaled q tile serves both s = (q*scale)@k
+        # and dk += ds^T (q*scale), so ds itself never needs scaling
         q = _zero_pad_rows(q_ref[0, 0], i, block_q, q_len)
+        q = q * jnp.asarray(sm_scale, q.dtype)
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = _zero_pad_rows(do_ref[0, 0], i, block_q, q_len)
@@ -278,11 +325,13 @@ def _bwd_dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale
-        mask = _block_mask(
-            s.shape, i, j, block_q=block_q, block_k=block_k,
-            causal=causal, q_len=q_len, kv_len=kv_len,
         )
+        mask = None
+        if masked:
+            mask = _block_mask(
+                s.shape, i, j, block_q=block_q, block_k=block_k,
+                causal=causal, q_len=q_len, kv_len=kv_len,
+            )
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -297,12 +346,15 @@ def _bwd_dkv_kernel(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * sm_scale
-        # dk += ds^T q
+        ds = p * (dp - delta)
+        # dk += ds^T (q*scale)
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    _dispatch_tile(run, _tile, i, j, causal=causal, block_q=block_q,
+                   block_k=block_k, q_len=q_len, kv_len=kv_len)
 
     @pl.when(i == num_q_blocks - 1)
     def _final():
@@ -396,22 +448,49 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+# The VJP is attached to an *identity* function whose inputs include the
+# kernel outputs (o, lse). The pallas forward call then lives in the
+# primal graph where ``checkpoint_name`` can tag it: under jax.checkpoint
+# with a policy saving "attn_out", the backward pass reuses the saved
+# (o, lse) instead of re-running the forward kernel — a custom_vjp's own
+# fwd residuals are invisible to checkpoint policies, so tagging must
+# happen at the primal level.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _anchor(q, k, v, o, lse, sm_scale, causal, block_q, block_k, interpret):
     return o
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+def _anchor_fwd(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
+                interpret):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, do)
+def _anchor_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k, interpret, res, do)
+    _, _, _, o, lse = res
+    return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_anchor.defvjp(_anchor_fwd, _anchor_bwd)
+
+
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
+    # stop_gradient on the *inputs* keeps AD tracing out of the pallas
+    # call entirely (it has no JVP rule); gradients flow only through
+    # the anchor's q/k/v arguments.
+    o, lse = _fwd(
+        jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
+        jax.lax.stop_gradient(v), sm_scale, causal, block_q, block_k,
+        interpret,
+    )
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_out")
+    return _anchor(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
+                   interpret)
 
 
 def flash_attention(
