@@ -1,5 +1,9 @@
 from dlrover_tpu.optimizers.agd import agd, scale_by_agd
-from dlrover_tpu.optimizers.wsam import make_wsam_grad_fn, wsam_update
+from dlrover_tpu.optimizers.wsam import (
+    make_wsam_grad_fn,
+    make_wsam_step_fn,
+    wsam_update,
+)
 from dlrover_tpu.optimizers.low_bit import adam8bit, scale_by_adam8bit
 from dlrover_tpu.optimizers.group_sparse import group_adagrad, group_adam
 from dlrover_tpu.optimizers.mup import (
@@ -13,6 +17,7 @@ __all__ = [
     "agd",
     "scale_by_agd",
     "make_wsam_grad_fn",
+    "make_wsam_step_fn",
     "wsam_update",
     "adam8bit",
     "scale_by_adam8bit",

@@ -3,18 +3,22 @@
 Equivalent capability: reference atorch/atorch/optimizers/agd.py:18
 ("AGD: an Auto-switchable Optimizer using Stepwise Gradient Difference
 for Preconditioning", NeurIPS 2023). The second moment accumulates the
-*difference* between successive gradients instead of the raw gradient —
-an approximation of the diagonal Hessian — and the update auto-switches
-between SGD-like (where sqrt(v̂) < delta) and adaptive behavior.
+squared *difference of successive bias-corrected first moments*
+(reference agd.py:119-131: ``update = m_t/bc1_t - m_{t-1}/bc1_{t-1}``,
+``nu += (1-b2) * update^2``) — an approximation of the diagonal Hessian
+— and the update auto-switches between SGD-like (where sqrt(nu_hat) is
+clamped at delta) and adaptive behavior.
 
 Implemented as an optax GradientTransformation; state is a pytree so it
 shards like the params under GSPMD (each device preconditions its own
-FSDP shard — no extra communication).
+FSDP shard — no extra communication). The previous bias-corrected
+moment is recomputed from the stored ``mu`` and the step count, so no
+extra state slot is needed for it.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,51 +28,77 @@ import optax
 class ScaleByAgdState(NamedTuple):
     count: jnp.ndarray
     mu: optax.Updates      # first moment of gradients
-    nu: optax.Updates      # second moment of gradient differences
-    prev_grad: optax.Updates
+    nu: optax.Updates      # second moment of moment differences
+    max_nu: optax.Updates  # amsgrad accumulator (zeros when disabled)
 
 
 def scale_by_agd(
     b1: float = 0.9,
     b2: float = 0.999,
     delta: float = 1e-5,
-    eps: float = 1e-8,
+    amsgrad: bool = False,
+    clip: Optional[float] = None,
 ) -> optax.GradientTransformation:
-    """Core AGD scaling (no lr / weight decay)."""
+    """Core AGD scaling (no lr / weight decay).
+
+    Matches the reference dynamics: with ``bc_i = 1 - b_i**t``,
+    ``diff_t = mu_t/bc1_t - mu_{t-1}/bc1_{t-1}`` (just ``mu_1/bc1_1`` at
+    t=1), ``nu_t = b2*nu_{t-1} + (1-b2)*diff_t**2``, and the update is
+    ``(mu_t/bc1_t) / max(sqrt(nu_t/bc2_t), delta)`` — the clamp at
+    ``delta`` is the SGD-like/adaptive auto-switch (no extra eps; the
+    reference clamps ``sqrt(nu_t)`` at ``delta*sqrt(bc2_t)``, which is
+    the same after dividing through by ``sqrt(bc2_t)``).
+    """
 
     def init_fn(params):
-        zeros = jax.tree.map(jnp.zeros_like, params)
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)  # noqa: E731
         return ScaleByAgdState(
             count=jnp.zeros((), jnp.int32),
-            mu=jax.tree.map(jnp.zeros_like, params),
-            nu=jax.tree.map(jnp.zeros_like, params),
-            prev_grad=zeros,
+            mu=zeros(),
+            nu=zeros(),
+            max_nu=zeros(),
         )
 
     def update_fn(updates, state, params=None):
         del params
         count = state.count + 1
-        # first step: the "difference" is the gradient itself (reference
-        # initializes the diff accumulator from g_1)
-        diff = jax.tree.map(
-            lambda g, pg: jnp.where(count == 1, g, g - pg),
-            updates, state.prev_grad,
-        )
+        countf = count.astype(jnp.float32)
+        bc1 = 1 - b1 ** countf
+        bc1_old = 1 - b1 ** (countf - 1)  # 0 at the first step
+        bc2 = 1 - b2 ** countf
         mu = optax.incremental_update(updates, state.mu, 1 - b1)
+        # diff of bias-corrected first moments; at t=1 the previous
+        # moment term is dropped (reference agd.py:125-129)
+        diff = jax.tree.map(
+            lambda m, m_old: jnp.where(
+                count == 1,
+                m / bc1,
+                m / bc1 - m_old / jnp.maximum(bc1_old, 1e-38),
+            ),
+            mu, state.mu,
+        )
         nu = jax.tree.map(
             lambda n, d: b2 * n + (1 - b2) * d * d, state.nu, diff
         )
-        mu_hat = jax.tree.map(lambda m: m / (1 - b1 ** count), mu)
-        nu_hat = jax.tree.map(lambda n: n / (1 - b2 ** count), nu)
+        if amsgrad:
+            max_nu = jax.tree.map(jnp.maximum, state.max_nu, nu)
+            denom_nu = max_nu
+        else:
+            max_nu = state.max_nu
+            denom_nu = nu
         # auto-switch: where sqrt(nu_hat) < delta the denominator clamps
         # to delta, giving constant (SGD-like) scaling; elsewhere the
         # adaptive preconditioner applies.
         new_updates = jax.tree.map(
-            lambda m, n: m / jnp.maximum(jnp.sqrt(n) + eps, delta),
-            mu_hat, nu_hat,
+            lambda m, n: m / bc1 / jnp.maximum(jnp.sqrt(n / bc2), delta),
+            mu, denom_nu,
         )
+        if clip is not None:
+            new_updates = jax.tree.map(
+                lambda u: jnp.clip(u, -clip, clip), new_updates
+            )
         return new_updates, ScaleByAgdState(
-            count=count, mu=mu, nu=nu, prev_grad=updates
+            count=count, mu=mu, nu=nu, max_nu=max_nu
         )
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -79,11 +109,13 @@ def agd(
     b1: float = 0.9,
     b2: float = 0.999,
     delta: float = 1e-5,
-    eps: float = 1e-8,
     weight_decay: float = 0.0,
+    amsgrad: bool = False,
+    clip: Optional[float] = None,
 ) -> optax.GradientTransformation:
     """AGD with decoupled (AdamW-style) weight decay."""
-    tx = [scale_by_agd(b1=b1, b2=b2, delta=delta, eps=eps)]
+    tx = [scale_by_agd(b1=b1, b2=b2, delta=delta, amsgrad=amsgrad,
+                       clip=clip)]
     if weight_decay:
         tx.append(optax.add_decayed_weights(weight_decay))
     tx.append(optax.scale_by_learning_rate(learning_rate))

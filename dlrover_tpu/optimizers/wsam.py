@@ -2,14 +2,20 @@
 
 Equivalent capability: reference atorch/atorch/optimizers/wsam.py:11
 (`WeightedSAM`, KDD 2023). The loss is regularized by weighted sharpness
-``L + gamma/(1-gamma) * (L(w+eps) - L(w))``; the gradient is a blend of
-the plain gradient and the SAM (perturbed) gradient.
+``L + gamma/(1-gamma) * (L(w+eps) - L(w))``. With ``alpha =
+gamma/(1-gamma)`` (the reference's weighting, wsam.py:45), the coupled
+gradient fed to the base optimizer is ``g + alpha*(g_adv - g)``; the
+reference's *default* mode is decoupled (wsam.py:34 ``decouple=True``),
+where the base optimizer steps with the plain gradient and the
+sharpness term ``alpha*(g_adv - g)`` is applied directly to the weights
+scaled by the learning rate (wsam.py:98-105) — outside the base
+optimizer's adaptive preconditioning.
 
 TPU-first: SAM needs two forward/backward passes per step. Instead of an
-optimizer class that closes over a closure (the torch pattern), we expose
-:func:`make_wsam_grad_fn`, which turns any ``loss_fn(params, batch, rng)``
-into a gradient function computing the WSAM direction *inside one jitted
-program* — XLA schedules both passes back-to-back and GSPMD shards both
+optimizer class that closes over a closure (the torch pattern), we
+expose :func:`make_wsam_grad_fn` (coupled gradient inside one jitted
+program) and :func:`make_wsam_step_fn` (full decoupled update step) —
+XLA schedules both passes back-to-back and GSPMD shards both
 identically, so the whole thing runs under the same mesh with no extra
 host round-trips.
 """
@@ -20,6 +26,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import optax
 
 
 def _global_norm(tree) -> jnp.ndarray:
@@ -29,16 +36,30 @@ def _global_norm(tree) -> jnp.ndarray:
 
 
 def wsam_update(grads, adv_grads, gamma: float = 0.9):
-    """Blend plain + perturbed gradients with sharpness weight gamma.
+    """Coupled WSAM gradient ``g + alpha*(g_adv - g)``, alpha=gamma/(1-gamma).
 
-    gamma=0 -> plain gradient (SGD); gamma=1 -> pure SAM gradient;
-    the reference's default gamma ~0.9 emphasizes the sharpness term as
-    ``g + gamma/(1-gamma) * (g_adv - g)`` normalized by 1/(1-gamma),
-    i.e. ``(1-gamma)*g + gamma*g_adv``.
+    gamma=0 -> plain gradient; gamma=0.5 (alpha=1) -> pure SAM gradient;
+    the reference's default gamma=0.9 (alpha=9) over-weights the
+    sharpness term. Matches reference wsam.py:91-92
+    (``grad*alpha + plain*(1-alpha)`` with their alpha = our 1-alpha
+    convention resolved: both give ``g + alpha*(g_adv-g)``).
     """
+    if gamma >= 1.0:
+        raise ValueError(f"gamma must be < 1, got {gamma}")
+    alpha = gamma / (1.0 - gamma)
     return jax.tree.map(
-        lambda g, ga: (1.0 - gamma) * g + gamma * ga, grads, adv_grads
+        lambda g, ga: g + alpha * (ga - g), grads, adv_grads
     )
+
+
+def _perturb(params, grads, rho: float, adaptive: bool, eps: float):
+    gnorm = _global_norm(grads)
+    scale = rho / (gnorm + eps)
+    if adaptive:
+        return jax.tree.map(
+            lambda p, g: p + jnp.square(p) * g * scale, params, grads
+        )
+    return jax.tree.map(lambda p, g: p + scale * g, params, grads)
 
 
 def make_wsam_grad_fn(
@@ -46,18 +67,65 @@ def make_wsam_grad_fn(
     rho: float = 0.05,
     gamma: float = 0.9,
     has_aux: bool = False,
+    adaptive: bool = False,
+    sam_eps: float = 1e-12,
 ) -> Callable:
     """Returns ``grad_fn(params, batch, rng) -> (loss, grads)`` computing
-    the WSAM direction (two passes fused into the caller's jit).
+    the *coupled* WSAM direction (two passes fused into the caller's
+    jit). For the reference's default decoupled behavior use
+    :func:`make_wsam_step_fn`.
     """
     grad = jax.value_and_grad(loss_fn, has_aux=has_aux)
 
     def wsam_grad(params, batch, rng):
         out, grads = grad(params, batch, rng)
-        gnorm = _global_norm(grads)
-        scale = rho / (gnorm + 1e-12)
-        perturbed = jax.tree.map(lambda p, g: p + scale * g, params, grads)
+        perturbed = _perturb(params, grads, rho, adaptive, sam_eps)
         _, adv_grads = grad(perturbed, batch, rng)
         return out, wsam_update(grads, adv_grads, gamma)
 
     return wsam_grad
+
+
+def make_wsam_step_fn(
+    loss_fn: Callable,
+    base_tx: optax.GradientTransformation,
+    learning_rate: float,
+    rho: float = 0.05,
+    gamma: float = 0.9,
+    decouple: bool = True,
+    adaptive: bool = False,
+    has_aux: bool = False,
+    sam_eps: float = 1e-12,
+) -> Callable:
+    """Full WSAM step in the reference's default *decoupled* mode.
+
+    Returns ``step(params, opt_state, batch, rng) -> (params, opt_state,
+    out)``. Decoupled: the base optimizer consumes the plain gradient,
+    then the weighted sharpness ``alpha*(g_adv - g)`` is subtracted from
+    the weights scaled by ``learning_rate`` (reference wsam.py:98-105).
+    ``decouple=False`` feeds the coupled blend to the base optimizer.
+    """
+    if gamma >= 1.0:
+        raise ValueError(f"gamma must be < 1, got {gamma}")
+    alpha = gamma / (1.0 - gamma)
+    grad = jax.value_and_grad(loss_fn, has_aux=has_aux)
+
+    def step(params, opt_state, batch, rng):
+        out, grads = grad(params, batch, rng)
+        perturbed = _perturb(params, grads, rho, adaptive, sam_eps)
+        _, adv_grads = grad(perturbed, batch, rng)
+        if decouple:
+            updates, opt_state2 = base_tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(updates=jax.tree.map(
+                lambda u, g, ga: u - learning_rate * alpha * (ga - g),
+                updates, grads, adv_grads,
+            ), params=params)
+        else:
+            blended = wsam_update(grads, adv_grads, gamma)
+            updates, opt_state2 = base_tx.update(
+                blended, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+        return new_params, opt_state2, out
+
+    return step

@@ -108,6 +108,20 @@ def _legacy_to_dotted(name: str) -> str:
     )
 
 
+def _translate_legacy_names(paths: list[str]) -> dict[str, str]:
+    """Per-checkpoint legacy-name mapping. Translation is applied only
+    when the dotted forms stay collision-free: a tree whose dotted names
+    collide (a dict key containing '.') is *saved* under raw keystr
+    names by design (`_tree_flatten_with_names` fallback), and
+    translating those back would merge distinct leaves — so such
+    checkpoints keep their raw names, which is exactly what the target
+    flatten produces for the same tree."""
+    translated = {p: _legacy_to_dotted(p) for p in paths}
+    if len(set(translated.values())) != len(paths):
+        return {p: p for p in paths}
+    return translated
+
+
 def _unique_addressable_shards(arr):
     """Deduplicate replicated shards: one entry per distinct index."""
     import jax
@@ -462,6 +476,12 @@ class CheckpointEngine:
         if result is None:
             return None
         meta, buf = result
+        # dedup: meta.leaves holds one entry per *shard*, so a multi-
+        # shard array repeats its path — the collision check must see
+        # unique paths only (mirrors the disk path)
+        names = _translate_legacy_names(
+            sorted({l.path for l in meta.leaves})
+        )
         leaf_map: dict[str, list[tuple[LeafMeta, np.ndarray]]] = {}
         for leaf in meta.leaves:
             # .copy(): never hand out views into the live shm buffer —
@@ -476,9 +496,7 @@ class CheckpointEngine:
                 .reshape(leaf.shape)
                 .copy()
             )
-            leaf_map.setdefault(
-                _legacy_to_dotted(leaf.path), []
-            ).append((leaf, arr))
+            leaf_map.setdefault(names[leaf.path], []).append((leaf, arr))
         if target is not None:
             # This host's shm may legitimately hold only a subset of the
             # leaves (sharded engine dedups host-replicated leaves to one
@@ -505,7 +523,7 @@ class CheckpointEngine:
         step_dir = path or self._latest_step_dir()
         if not step_dir or not os.path.isdir(step_dir):
             return None
-        leaf_map: dict[str, list[tuple[LeafMeta, np.ndarray]]] = {}
+        entries: list[tuple[LeafMeta, np.ndarray]] = []
         step = -1
         for fname in sorted(os.listdir(step_dir)):
             if not fname.endswith(".dlck"):
@@ -522,17 +540,33 @@ class CheckpointEngine:
                     count=_count(leaf.shape),
                     offset=leaf.offset,
                 ).reshape(leaf.shape)
-                leaf_map.setdefault(
-                    _legacy_to_dotted(leaf.path), []
-                ).append((leaf, arr))
-        if not leaf_map:
+                entries.append((leaf, arr))
+        if not entries:
             return None
+        names = _translate_legacy_names(
+            sorted({leaf.path for leaf, _ in entries})
+        )
+        leaf_map: dict[str, list[tuple[LeafMeta, np.ndarray]]] = {}
+        for leaf, arr in entries:
+            leaf_map.setdefault(names[leaf.path], []).append((leaf, arr))
         if not _covers_global(leaf_map):
             logger.warning(
                 "checkpoint at %s is missing shards; refusing a partial "
                 "restore", step_dir,
             )
             return None
+        if target is not None:
+            # completeness bail-out (mirrors the shm path): a disk
+            # checkpoint missing whole leaves (e.g. after a model change)
+            # must not silently mix checkpointed and fresh-init values
+            tnames, _, _ = _tree_flatten_with_names(target)
+            missing = [n for n in tnames if n not in leaf_map]
+            if missing:
+                raise ValueError(
+                    f"checkpoint at {step_dir} is missing "
+                    f"{len(missing)} target leaves (e.g. {missing[:3]}) "
+                    f"— refusing a partial restore of a changed model"
+                )
         state = _assemble(leaf_map)
         logger.info("restored step %s from %s", step, step_dir)
         return _fill_target(state, target, step)

@@ -144,16 +144,82 @@ class Trainer:
 
     # -------------------------------------------------------------- resume
 
+    _DATA_STATE_BYTES = 4096
+
+    def _pack_data_state(self):
+        """Dataloader/sampler progress as a fixed-size JSON leaf so it
+        rides the same checkpoint tree (and target-matching) as the
+        train state (reference AtorchTrainer persists sampler state)."""
+        import json
+
+        import numpy as np
+
+        sd = self.train_data.state_dict()
+        raw = json.dumps(sd).encode()
+        if len(raw) > self._DATA_STATE_BYTES:
+            logger.warning(
+                "dataloader state too large to checkpoint (%d bytes)",
+                len(raw),
+            )
+            return None
+        buf = np.zeros(self._DATA_STATE_BYTES, np.uint8)
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+        return buf
+
+    def _ckpt_tree(self):
+        tree = {"train": self.state}
+        if hasattr(self.train_data, "state_dict"):
+            packed = self._pack_data_state()
+            if packed is not None:
+                tree["data"] = packed
+        return tree
+
     def maybe_resume(self) -> int:
-        """Restore the newest checkpoint (shm preferred, then storage).
+        """Restore the newest checkpoint (shm preferred, then storage),
+        including dataloader/sampler progress so a restarted job picks
+        up mid-epoch instead of replaying from offset 0.
         Returns the restored step (0 = fresh)."""
         if self._engine is None:
             return 0
-        restored = self._engine.load(target=self.state)
+        # fallback targets: a checkpoint written without the data leaf
+        # (oversized loader state) and the pre-wrapper layout (bare
+        # train state) must both keep restoring
+        targets = [self._ckpt_tree()]
+        if "data" in targets[0]:
+            targets.append({"train": self.state})
+        targets.append(self.state)
+        restored = None
+        first_err = None
+        for tgt in targets:
+            try:
+                restored = self._engine.load(target=tgt)
+            except ValueError as err:
+                if first_err is None:
+                    first_err = err
+                continue
+            if restored is not None:
+                break
         if restored is None:
+            if first_err is not None:
+                raise first_err
             return 0
-        state, step = restored
-        self.state = state
+        tree, step = restored
+        if isinstance(tree, dict) and "train" in tree:
+            self.state = tree["train"]
+            if "data" in tree and hasattr(
+                self.train_data, "load_state_dict"
+            ):
+                import json
+
+                import numpy as np
+
+                raw = np.asarray(tree["data"]).tobytes().rstrip(b"\x00")
+                if raw:
+                    self.train_data.load_state_dict(
+                        json.loads(raw.decode())
+                    )
+        else:
+            self.state = tree
         self.global_step = int(step)
         logger.info("resumed from checkpoint step %s", step)
         return self.global_step
@@ -164,7 +230,7 @@ class Trainer:
         import jax
 
         args = self.args
-        self.maybe_resume()
+        resumed = self.maybe_resume()
         metrics = {}
         shm_saves = 0
         # a job resumed at/after max_steps is already done: don't train
@@ -173,12 +239,19 @@ class Trainer:
         from dlrover_tpu.agent.monitor import write_runtime_metrics
         from dlrover_tpu.trainer.timer import Tag
 
-        for epoch in range(args.num_epochs):
+        sampler = getattr(self.train_data, "sampler", None)
+        # resume into the restored sampler epoch; don't set_epoch on the
+        # resumed epoch itself (it would clear the mid-epoch offset)
+        start_epoch = 0
+        if resumed and sampler is not None:
+            start_epoch = min(
+                int(getattr(sampler, "epoch", 0)), args.num_epochs - 1
+            )
+        for epoch in range(start_epoch, args.num_epochs):
             if stop:
                 break
-            sampler = getattr(self.train_data, "sampler", None)
             if sampler is not None and hasattr(sampler, "set_epoch"):
-                if epoch > 0:
+                if epoch != start_epoch:
                     sampler.set_epoch(epoch)
             for batch in self.train_data:
                 if self._profiler is not None:
@@ -235,11 +308,10 @@ class Trainer:
     def save_checkpoint(self, persist: bool = False):
         if self._engine is None:
             return False
+        tree = self._ckpt_tree()
         if persist:
-            return self._engine.save_to_storage(
-                self.global_step, self.state
-            )
-        return self._engine.save_to_memory(self.global_step, self.state)
+            return self._engine.save_to_storage(self.global_step, tree)
+        return self._engine.save_to_memory(self.global_step, tree)
 
     # ---------------------------------------------------------------- eval
 

@@ -453,3 +453,40 @@ class TestLeafNaming:
             np.asarray(restored["params"]["w"]), 3.0
         )
         e.close()
+
+    def test_colliding_names_roundtrip(self, tmp_path):
+        """A tree whose dotted names collide saves under keystr names;
+        the load path must NOT legacy-translate those back (it would
+        merge the distinct leaves) — the roundtrip stays lossless."""
+        import jax.numpy as _jnp
+
+        e = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        state = {"a": {"b": _jnp.full((1,), 1.0)},
+                 "a.b": _jnp.full((2,), 2.0)}
+        assert e.save_to_memory(3, state)
+        target = {"a": {"b": _jnp.zeros((1,))}, "a.b": _jnp.zeros((2,))}
+        restored, step = e.load(target=target)
+        assert step == 3
+        np.testing.assert_allclose(np.asarray(restored["a"]["b"]), 1.0)
+        np.testing.assert_allclose(np.asarray(restored["a.b"]), 2.0)
+        e.close()
+
+
+class TestStorageCompleteness:
+    def test_storage_restore_refuses_missing_leaves(self, tmp_path):
+        """A disk checkpoint missing whole target leaves (model changed)
+        must raise instead of silently mixing checkpointed and
+        fresh-init values (mirrors the shm path's bail-out)."""
+        import jax.numpy as _jnp
+        import pytest as _pytest
+
+        e = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        state = {"params": {"w": _jnp.full((4,), 3.0)}}
+        assert e.save_to_storage(2, state)
+        assert e.wait_for_persist(2, timeout=60)
+        e._shm_handler.close(unlink=True)  # force the storage path
+        target = {"params": {"w": _jnp.zeros((4,)),
+                             "extra": _jnp.zeros((2,))}}
+        with _pytest.raises(ValueError, match="missing"):
+            e.load_from_storage(target=target)
+        e.close()

@@ -11,6 +11,7 @@ from dlrover_tpu.optimizers import (
     adam8bit,
     agd,
     make_wsam_grad_fn,
+    make_wsam_step_fn,
     wsam_update,
 )
 
@@ -75,6 +76,41 @@ class TestAGD:
         leaves = jax.tree.leaves(state)
         assert all(isinstance(l, jax.Array) for l in leaves)
 
+    def test_preconditioner_matches_reference_dynamics(self):
+        """nu must accumulate squared diffs of *bias-corrected first
+        moments* (reference agd.py:119-131), not raw gradient diffs —
+        replay 3 fixed gradients through the transform and check the
+        update against a hand-rolled reference recurrence.
+        """
+        b1, b2, delta, lr = 0.9, 0.999, 1e-5, 1.0
+        grads = [np.float32(1.0), np.float32(0.5), np.float32(-0.25)]
+        opt = agd(lr, b1=b1, b2=b2, delta=delta)
+        params = {"w": jnp.zeros(())}
+        state = opt.init(params)
+
+        mu = nu = 0.0
+        m_hat_old = None
+        for t, g in enumerate(grads, start=1):
+            updates, state = opt.update({"w": jnp.asarray(g)}, state,
+                                        params)
+            mu = b1 * mu + (1 - b1) * g
+            bc1, bc2 = 1 - b1**t, 1 - b2**t
+            m_hat = mu / bc1
+            diff = m_hat if t == 1 else m_hat - m_hat_old
+            m_hat_old = m_hat
+            nu = b2 * nu + (1 - b2) * diff * diff
+            expected = -lr * m_hat / max(np.sqrt(nu / bc2), delta)
+            np.testing.assert_allclose(
+                float(updates["w"]), expected, rtol=1e-5
+            )
+
+    def test_amsgrad_and_clip(self):
+        opt = agd(1e-2, amsgrad=True, clip=0.1)
+        params = {"w": jnp.zeros((4,))}
+        state = opt.init(params)
+        updates, state = opt.update({"w": jnp.ones((4,))}, state, params)
+        assert float(jnp.max(jnp.abs(updates["w"]))) <= 0.1 * 1e-2 + 1e-9
+
 
 class TestWSAM:
     def test_gamma_zero_is_plain_grad(self):
@@ -83,11 +119,20 @@ class TestWSAM:
         out = wsam_update(g, ga, gamma=0.0)
         np.testing.assert_allclose(out["w"], g["w"])
 
-    def test_gamma_one_is_sam_grad(self):
+    def test_gamma_half_is_sam_grad(self):
+        # alpha = gamma/(1-gamma) = 1 -> pure SAM gradient
         g = {"w": jnp.ones((3,))}
         ga = {"w": jnp.full((3,), 5.0)}
-        out = wsam_update(g, ga, gamma=1.0)
+        out = wsam_update(g, ga, gamma=0.5)
         np.testing.assert_allclose(out["w"], ga["w"])
+
+    def test_default_gamma_overweights_sharpness(self):
+        # reference weighting: g + alpha*(g_adv - g) with alpha=9 at
+        # gamma=0.9 — hyperparameters must transfer from the reference
+        g = {"w": jnp.ones((3,))}
+        ga = {"w": jnp.full((3,), 2.0)}
+        out = wsam_update(g, ga, gamma=0.9)
+        np.testing.assert_allclose(out["w"], 1.0 + 9.0 * 1.0, rtol=1e-6)
 
     def test_wsam_grad_fn_converges(self):
         loss, params = quadratic_problem()
@@ -106,23 +151,63 @@ class TestWSAM:
         assert float(l) < 1e-3
 
     def test_blend_matches_definition(self):
-        # wsam grad must equal (1-gamma)*g(w) + gamma*g(w + rho*g/|g|)
+        # coupled wsam grad must equal g + alpha*(g_adv - g) with
+        # alpha = gamma/(1-gamma) and g_adv = g(w + rho*g/|g|)
         def loss(p, batch=None, rng=None):
             x = p["x"]
             return jnp.minimum((x + 1.0) ** 2, 50.0 * (x - 1.0) ** 2)
 
         rho, gamma = 0.2, 0.9
+        alpha = gamma / (1 - gamma)
         p = {"x": jnp.float32(0.9)}
         plain = jax.grad(loss)(p)["x"]
         eps = rho * plain / jnp.abs(plain)
         adv = jax.grad(loss)({"x": p["x"] + eps})["x"]
-        expected = (1 - gamma) * plain + gamma * adv
+        expected = plain + alpha * (adv - plain)
         _, wsam_g = make_wsam_grad_fn(loss, rho=rho, gamma=gamma)(
             p, None, None
         )
         np.testing.assert_allclose(
             float(wsam_g["x"]), float(expected), rtol=1e-5
         )
+
+    def test_decoupled_step_applies_sharpness_outside_base(self):
+        """Decoupled (reference default): base optimizer consumes the
+        plain gradient; sharpness alpha*(g_adv-g) is applied scaled by
+        lr, bypassing the base preconditioner. With SGD base the result
+        equals -lr*(g + alpha*(g_adv-g)); with a sign-like base the
+        sharpness term still enters linearly.
+        """
+        def loss(p, batch=None, rng=None):
+            x = p["x"]
+            return jnp.minimum((x + 1.0) ** 2, 50.0 * (x - 1.0) ** 2)
+
+        rho, gamma, lr = 0.2, 0.9, 1e-2
+        alpha = gamma / (1 - gamma)
+        p = {"x": jnp.float32(0.9)}
+        plain = jax.grad(loss)(p)["x"]
+        eps = rho * plain / jnp.abs(plain)
+        adv = jax.grad(loss)({"x": p["x"] + eps})["x"]
+
+        base = optax.sgd(lr)
+        step = make_wsam_step_fn(loss, base, lr, rho=rho, gamma=gamma,
+                                 decouple=True)
+        new_p, _, _ = step(p, base.init(p), None, None)
+        expected = p["x"] - lr * (plain + alpha * (adv - plain))
+        np.testing.assert_allclose(
+            float(new_p["x"]), float(expected), rtol=1e-5
+        )
+
+    def test_decoupled_step_converges(self):
+        loss, params = quadratic_problem()
+        base = optax.sgd(5e-2)
+        step = jax.jit(make_wsam_step_fn(
+            loss, base, 5e-2, rho=0.01, gamma=0.5, decouple=True
+        ))
+        state = base.init(params)
+        for _ in range(300):
+            params, state, l = step(params, state, None, None)
+        assert float(l) < 1e-3
 
 
 class TestAdam8bit:

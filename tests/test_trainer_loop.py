@@ -143,6 +143,104 @@ class TestTrainerCheckpointResume:
         t2.close()
 
 
+class TestTrainerDataStateResume:
+    def _make_loader(self):
+        from dlrover_tpu.trainer.elastic.dataloader import (
+            ElasticDataLoader,
+        )
+
+        rs = np.random.RandomState(1)
+        w_true = rs.randn(8, 1).astype(np.float32)
+        xs = rs.randn(64, 8).astype(np.float32)
+        dataset = [(xs[i], xs[i] @ w_true) for i in range(64)]
+        return ElasticDataLoader(dataset, batch_size=8, config_file="")
+
+    def test_mid_epoch_resume_restores_dataloader(self, tmp_path):
+        """A restarted job must pick up the epoch where it left off, not
+        replay from offset 0 (reference AtorchTrainer persists sampler
+        state with the checkpoint)."""
+        loss_fn, init_fn, axes, _ = linear_problem()
+        args = make_args(
+            tmp_path, max_steps=3, flash_checkpoint=True, num_epochs=1
+        )
+        t1 = Trainer(loss_fn, init_fn, axes, args,
+                     train_data=self._make_loader())
+        t1.train()  # 3 steps of 8 samples; final ckpt carries data state
+        consumed = t1.train_data.sampler.completed_num
+        assert consumed == 24
+        t1.close()
+        AsyncCheckpointSaver.reset()
+
+        loader2 = self._make_loader()
+        t2 = Trainer(loss_fn, init_fn, axes, args, train_data=loader2)
+        restored = t2.maybe_resume()
+        assert restored == 3
+        assert loader2.sampler.completed_num == consumed
+        t2.close()
+
+    def test_pre_wrapper_checkpoint_still_restores(self, tmp_path):
+        """Checkpoints written before the {'train','data'} wrapper (bare
+        train-state leaves) must keep restoring."""
+        import os
+
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            ShardedCheckpointEngine,
+        )
+
+        loss_fn, init_fn, axes, _ = linear_problem()
+        args = make_args(
+            tmp_path, max_steps=3, flash_checkpoint=True, num_epochs=1
+        )
+        t1 = Trainer(loss_fn, init_fn, axes, args,
+                     train_data=self._make_loader())
+        t1.train()
+        old_state = t1.state
+        t1.close()
+        AsyncCheckpointSaver.reset()
+        # overwrite with an old-layout (bare state) checkpoint
+        eng = ShardedCheckpointEngine(
+            os.path.join(args.output_dir, "checkpoints")
+        )
+        assert eng.save_to_storage(7, old_state)
+        assert eng.wait_for_persist(7, timeout=60)
+        eng.close()
+        AsyncCheckpointSaver.reset()
+
+        t2 = Trainer(loss_fn, init_fn, axes, args,
+                     train_data=self._make_loader())
+        assert t2.maybe_resume() == 7
+        np.testing.assert_allclose(
+            np.asarray(t2.state.params["w"]),
+            np.asarray(old_state.params["w"]), rtol=1e-6,
+        )
+        t2.close()
+
+    def test_resumed_epoch_not_reset(self, tmp_path):
+        """train() after resume must not set_epoch() on the resumed
+        epoch (it would clear the mid-epoch offset)."""
+        loss_fn, init_fn, axes, _ = linear_problem()
+        args = make_args(
+            tmp_path, max_steps=3, flash_checkpoint=True, num_epochs=2
+        )
+        t1 = Trainer(loss_fn, init_fn, axes, args,
+                     train_data=self._make_loader())
+        t1.train()
+        t1.close()
+        AsyncCheckpointSaver.reset()
+
+        loader2 = self._make_loader()
+        args2 = make_args(
+            tmp_path, max_steps=5, flash_checkpoint=True, num_epochs=2
+        )
+        t2 = Trainer(loss_fn, init_fn, axes, args2, train_data=loader2)
+        t2.train()
+        # resumed at 24/64 consumed; 2 more steps -> 40, same epoch
+        assert t2.global_step == 5
+        assert loader2.sampler.epoch == 0
+        assert loader2.sampler.completed_num == 40
+        t2.close()
+
+
 class TestProfiler:
     def test_step_window_produces_trace(self, tmp_path):
         loss_fn, init_fn, axes, batches = linear_problem()
