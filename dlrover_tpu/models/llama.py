@@ -57,12 +57,25 @@ class LlamaConfig:
     # pipeline microbatches when the ``pipe`` mesh axis is active
     # (0 = default 2 * n_stages)
     pipe_microbatches: int = 0
+    # "gpipe" (activation-returning schedule, AD-derived backward) or
+    # "1f1b" (loss-in-pipeline fused schedule, in-flight activations
+    # bounded by pipeline depth — reference default Interleaved1F1B,
+    # pipeline_parallel_optimization.py:98). "1f1b" affects the
+    # training loss path only; plain forwards always use gpipe.
+    pipe_schedule: str = "gpipe"
     # MoE (mixtral-style FFN swap): 0/1 experts = dense
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     moe_z_weight: float = 1e-3
+
+    def __post_init__(self):
+        if self.pipe_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipe_schedule must be 'gpipe' or '1f1b', got "
+                f"{self.pipe_schedule!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -335,6 +348,26 @@ def _layer(config: LlamaConfig, x, layer_params, positions):
     return shard_logical(x, ("batch", "seq", "embed")), aux
 
 
+def _stage_fn(config: LlamaConfig):
+    """Per-stage layer-scan closure shared by the pipeline schedules."""
+    from dlrover_tpu.parallel.pipeline import stage_layer_scan
+
+    policy = {
+        "dots_attn": jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        ),
+        "dots_no_batch":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots": jax.checkpoint_policies.dots_saveable,
+    }[config.remat_policy]
+    return stage_layer_scan(
+        lambda h, lp, pos: _layer(config, h, lp, pos),
+        remat=config.remat,
+        policy=policy,
+    )
+
+
 def llama_apply(config: LlamaConfig, params, tokens, positions=None,
                 return_aux: bool = False):
     """tokens [B, S] int32 -> logits [B, S, vocab] float32.
@@ -349,26 +382,9 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None,
     x = params["embed"].astype(dtype)[tokens]
     x = shard_logical(x, ("batch", "seq", "embed"))
 
-    from dlrover_tpu.parallel.pipeline import (
-        pipe_size,
-        pipeline_apply,
-        stage_layer_scan,
-    )
+    from dlrover_tpu.parallel.pipeline import pipe_size, pipeline_apply
 
-    policy = {
-        "dots_attn": jax.checkpoint_policies.save_from_both_policies(
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            jax.checkpoint_policies.save_only_these_names("attn_out"),
-        ),
-        "dots_no_batch":
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        "dots": jax.checkpoint_policies.dots_saveable,
-    }[config.remat_policy]
-    stage_fn = stage_layer_scan(
-        lambda h, lp, pos: _layer(config, h, lp, pos),
-        remat=config.remat,
-        policy=policy,
-    )
+    stage_fn = _stage_fn(config)
     if pipe_size() > 1:
         # layer stack sharded over the ``pipe`` axis: GPipe microbatch
         # schedule inside the step (parallel/pipeline.py), embed/head
@@ -389,11 +405,44 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None,
     return logits
 
 
+def _llama_1f1b_loss(config: LlamaConfig, params, tokens):
+    """Training loss through the 1F1B schedule: the final norm + head +
+    CE run as the pipeline's last stage (loss-in-pipeline), bounding
+    in-flight microbatch activations by the pipeline depth."""
+    from dlrover_tpu.parallel.pipeline import pipeline_loss_1f1b
+
+    dtype = jnp.dtype(config.dtype)
+    inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    B, S = inputs.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = params["embed"].astype(dtype)[inputs]
+    x = shard_logical(x, ("batch", "seq", "embed"))
+
+    def last_fn(lp, h, labels_mb):
+        h = _rms_norm(h, lp["final_norm"], config.norm_eps)
+        logits = (h @ lp["lm_head"].astype(dtype)).astype(jnp.float32)
+        loss, valid = softmax_cross_entropy(logits, labels_mb)
+        return loss.sum() / jnp.maximum(valid.sum(), 1)
+
+    last_params = {
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+    return pipeline_loss_1f1b(
+        _stage_fn(config), last_fn, params["layers"], last_params, x,
+        stage_extras=(positions,), last_extras=(labels,),
+        n_microbatches=config.pipe_microbatches,
+    )
+
+
 def llama_loss_fn(config: LlamaConfig):
     """Next-token CE loss closure for auto_accelerate."""
+    from dlrover_tpu.parallel.pipeline import pipe_size
 
     def loss_fn(params, batch, rng):
         tokens = batch["tokens"]
+        if config.pipe_schedule == "1f1b" and pipe_size() > 1:
+            return _llama_1f1b_loss(config, params, tokens)
         logits, aux = llama_apply(
             config, params, tokens[:, :-1], return_aux=True
         )

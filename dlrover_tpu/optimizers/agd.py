@@ -29,7 +29,7 @@ class ScaleByAgdState(NamedTuple):
     count: jnp.ndarray
     mu: optax.Updates      # first moment of gradients
     nu: optax.Updates      # second moment of moment differences
-    max_nu: optax.Updates  # amsgrad accumulator (zeros when disabled)
+    max_nu: optax.Updates  # amsgrad accumulator (empty tuple if disabled)
 
 
 def scale_by_agd(
@@ -56,7 +56,8 @@ def scale_by_agd(
             count=jnp.zeros((), jnp.int32),
             mu=zeros(),
             nu=zeros(),
-            max_nu=zeros(),
+            # no param-sized slot unless amsgrad actually needs it
+            max_nu=zeros() if amsgrad else (),
         )
 
     def update_fn(updates, state, params=None):
@@ -84,7 +85,7 @@ def scale_by_agd(
             max_nu = jax.tree.map(jnp.maximum, state.max_nu, nu)
             denom_nu = max_nu
         else:
-            max_nu = state.max_nu
+            max_nu = ()
             denom_nu = nu
         # auto-switch: where sqrt(nu_hat) < delta the denominator clamps
         # to delta, giving constant (SGD-like) scaling; elsewhere the

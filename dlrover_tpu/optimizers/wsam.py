@@ -89,7 +89,7 @@ def make_wsam_grad_fn(
 def make_wsam_step_fn(
     loss_fn: Callable,
     base_tx: optax.GradientTransformation,
-    learning_rate: float,
+    learning_rate,
     rho: float = 0.05,
     gamma: float = 0.9,
     decouple: bool = True,
@@ -99,25 +99,40 @@ def make_wsam_step_fn(
 ) -> Callable:
     """Full WSAM step in the reference's default *decoupled* mode.
 
-    Returns ``step(params, opt_state, batch, rng) -> (params, opt_state,
-    out)``. Decoupled: the base optimizer consumes the plain gradient,
-    then the weighted sharpness ``alpha*(g_adv - g)`` is subtracted from
-    the weights scaled by ``learning_rate`` (reference wsam.py:98-105).
-    ``decouple=False`` feeds the coupled blend to the base optimizer.
+    Returns ``step(params, opt_state, batch, rng, step=None) ->
+    (params, opt_state, out)``. Decoupled: the base optimizer consumes
+    the plain gradient, then the weighted sharpness ``alpha*(g_adv -
+    g)`` is subtracted from the weights scaled by the learning rate
+    (reference wsam.py:98-105). ``decouple=False`` feeds the coupled
+    blend to the base optimizer.
+
+    ``learning_rate`` may be a float or an optax schedule; a schedule
+    requires passing the current ``step`` so the decoupled sharpness
+    term tracks the base optimizer's decayed lr (the reference reads
+    the group's current lr each step).
     """
     if gamma >= 1.0:
         raise ValueError(f"gamma must be < 1, got {gamma}")
     alpha = gamma / (1.0 - gamma)
     grad = jax.value_and_grad(loss_fn, has_aux=has_aux)
 
-    def step(params, opt_state, batch, rng):
+    def step(params, opt_state, batch, rng, step=None):
+        if callable(learning_rate):
+            if step is None:
+                raise ValueError(
+                    "learning_rate is a schedule: pass the current "
+                    "step to make_wsam_step_fn's step(..., step=...)"
+                )
+            lr = learning_rate(step)
+        else:
+            lr = learning_rate
         out, grads = grad(params, batch, rng)
         perturbed = _perturb(params, grads, rho, adaptive, sam_eps)
         _, adv_grads = grad(perturbed, batch, rng)
         if decouple:
             updates, opt_state2 = base_tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(updates=jax.tree.map(
-                lambda u, g, ga: u - learning_rate * alpha * (ga - g),
+                lambda u, g, ga: u - lr * alpha * (ga - g),
                 updates, grads, adv_grads,
             ), params=params)
         else:

@@ -37,6 +37,7 @@ from dlrover_tpu.parallel.accelerate import (  # noqa: F401
 from dlrover_tpu.parallel.pipeline import (  # noqa: F401
     pipe_size,
     pipeline_apply,
+    pipeline_loss_1f1b,
     stage_layer_scan,
 )
 from dlrover_tpu.parallel.moe import (  # noqa: F401

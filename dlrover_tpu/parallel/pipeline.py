@@ -89,10 +89,10 @@ def pipeline_apply(
     extra_mb = tuple(to_micro(a) for a in broadcast_args)
 
     # XLA:CPU (jax 0.9.0) CHECK-fails ("invalid binary instruction opcode
-    # copy") when differentiating bf16 select/psum patterns at the manual-
-    # region boundary. Keep boundary arrays f32 (free on TPU: the psum/
-    # select cotangents accumulate in f32 anyway) and compute in the
-    # model's dtype inside.
+    # copy") when differentiating bf16 select patterns at the manual-
+    # region *input* boundary. Keep the input boundary f32 and compute in
+    # the model's dtype inside; the output crosses the boundary in
+    # compute dtype (stacked P(pipe) + slice, no select/psum involved).
     compute_dtype = x.dtype
     cast_boundary = (
         jnp.issubdtype(compute_dtype, jnp.floating)
@@ -114,6 +114,13 @@ def pipeline_apply(
 
         def tick(carry, t):
             state, outbuf, aux_sum = carry
+            # serialize the per-tick (loop-invariant) param all-gathers
+            # behind the previous tick's ppermute — see the matching
+            # barrier in pipeline_loss_1f1b for why (XLA:CPU rendezvous
+            # mispairing across scan iterations)
+            params_t, state = jax.lax.optimization_barrier(
+                (params_local, state)
+            )
             feed = jnp.clip(t, 0, M - 1)
             inject = jax.lax.dynamic_index_in_dim(
                 x_mb, feed, 0, keepdims=False
@@ -123,7 +130,7 @@ def pipeline_apply(
                 jax.lax.dynamic_index_in_dim(e, feed, 0, keepdims=False)
                 for e in extra_mb
             )
-            out, aux = stage_fn(params_local, cur, *extras)
+            out, aux = stage_fn(params_t, cur, *extras)
             # Valid (non-bubble) ticks for this stage process microbatch
             # t - stage; mask the aux contribution of bubble garbage.
             valid = (t >= stage) & (t < M + stage)
@@ -145,39 +152,341 @@ def pipeline_apply(
             (state0, outbuf0, jnp.zeros((), jnp.float32)),
             jnp.arange(T),
         )
-        # Replicate the result (held by the last stage) across pipe; each
-        # stage contributed its own layers' aux, so aux is a plain psum.
-        # The masked psum runs in f32 (see cast_boundary note above).
-        outbuf = jax.lax.psum(
-            jnp.where(
-                stage == S - 1, outbuf, jnp.zeros_like(outbuf)
-            ).astype(jnp.float32),
-            AXIS,
-        )
-        if not cast_boundary:
-            outbuf = outbuf.astype(compute_dtype)
+        # The result lives on the last stage only. Return the per-stage
+        # buffers stacked over ``pipe`` (out_specs P(AXIS)); the caller
+        # slices out the last stage's piece, which GSPMD lowers to a
+        # one-hop transfer from its owner — cheaper than the previous
+        # masked psum of the whole buffer (an all-reduce where a
+        # broadcast suffices).
         # Each valid tick contributed one per-microbatch mean; average
         # over M so aux matches the dense path's full-batch mean.
         aux_total = jax.lax.psum(aux_sum, AXIS) / M
-        return outbuf, aux_total
+        return outbuf[None], aux_total
 
     n_extra = len(extra_mb)
     from dlrover_tpu.parallel import get_shard_map
 
-    out_mb, aux_total = get_shard_map()(
+    out_stacked, aux_total = get_shard_map()(
         schedule,
         mesh=mesh,
         in_specs=(
             jax.tree.map(lambda _: P(AXIS), stage_params),
             P(),
         ) + (P(),) * n_extra,
-        out_specs=(P(), P()),
+        out_specs=(P(AXIS), P()),
         axis_names={AXIS},
         check_vma=False,
     )(stage_params, x_mb, *extra_mb)
-    if cast_boundary:
-        out_mb = out_mb.astype(compute_dtype)
+    # one-hop broadcast: slice the last stage's shard of the stacked
+    # [S, M, ...] output (physically [1, ...] per stage)
+    out_mb = jax.lax.slice_in_dim(out_stacked, S - 1, S, axis=0)[0]
     return out_mb.reshape(x.shape), aux_total
+
+
+def pipeline_loss_1f1b(
+    stage_fn: Callable,
+    last_fn: Callable,
+    stage_params,
+    last_params,
+    x,
+    stage_extras=(),
+    last_extras=(),
+    n_microbatches: int = 0,
+    mesh=None,
+):
+    """1F1B pipeline schedule with the loss computed in the last stage.
+
+    The reference's default pipeline schedule is interleaved 1F1B
+    (atorch/atorch/auto/opt_lib/pipeline_parallel_optimization.py:98
+    ``Interleaved1F1B``): backward of microbatch m starts as soon as its
+    forward reaches the last stage, while later microbatches are still
+    in flight, which bounds the stored boundary activations per stage to
+    O(S) instead of O(M). That property requires the output cotangent
+    *during* the schedule — i.e. the loss must live inside the pipeline
+    — so unlike :func:`pipeline_apply` this variant takes the last-stage
+    head/loss as ``last_fn`` and returns the scalar loss.
+
+    TPU redesign: one fused fwd+bwd schedule inside a single
+    ``lax.scan`` under ``shard_map`` over the ``pipe`` axis. At tick t,
+    stage s runs forward for microbatch ``f = t - s`` and backward (a
+    local ``jax.vjp`` re-linearisation at the saved stage input) for
+    ``b = t - 2(S-1) + s``; activation messages ``ppermute`` up, cotangent
+    messages down, each one microbatch in size. Stage inputs live in a
+    ring buffer of ``2S-1`` slots — in-flight microbatch activations are
+    bounded by the pipeline depth, independent of M. Because gradients
+    are linear in the scalar loss cotangent, the whole thing is a
+    ``jax.custom_vjp`` whose forward also produces the grads and whose
+    backward just scales them — no AD through the schedule.
+
+    Args:
+      stage_fn: ``(local_params, h, *stage_extras_mb) -> (h, aux)``.
+      last_fn: ``(last_params, h, *last_extras_mb) -> scalar`` loss for
+        one microbatch (e.g. final norm + head + CE mean). The total
+        loss is the mean over microbatches of ``last_fn`` plus the mean
+        aux — mean-of-microbatch-means, which equals the global mean
+        when every microbatch has the same valid-token count.
+      stage_params: stacked ``[L, ...]`` pytree sharded over ``pipe``.
+      last_params: pytree replicated over ``pipe`` (head weights).
+      x: activations ``[B, ...]``; ``stage_extras``/``last_extras`` are
+        microbatched alongside (leading batch dim) and treated as
+        non-differentiable (zero cotangents).
+
+    Returns the scalar loss (CE mean + aux mean).
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    S = mesh.shape.get(AXIS, 1)
+    if S == 1:
+        h, aux = stage_fn(stage_params, x, *stage_extras)
+        return last_fn(last_params, h, *last_extras) + aux
+
+    M = int(n_microbatches) if n_microbatches else 2 * S
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+
+    def to_micro(a):
+        return a.reshape((M, a.shape[0] // M) + a.shape[1:])
+
+    x_mb = to_micro(x)
+    sx_mb = tuple(to_micro(a) for a in stage_extras)
+    lx_mb = tuple(to_micro(a) for a in last_extras)
+
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.parallel import get_shard_map
+
+    R = 2 * S - 1        # ring-buffer slots: max in-flight stage inputs
+    T = M + 2 * (S - 1)  # fwd drains at M+S-2, bwd at M-1+2(S-1)
+
+    def _idx(a, i):
+        return jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+
+    def schedule(params_local, last_params_, x_mb_, sx_mb_, lx_mb_):
+        stage = jax.lax.axis_index(AXIS)
+        is_last = stage == S - 1
+        mb_shape = x_mb_.shape[1:]
+
+        def f32_zeros_like(tree):
+            return jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), tree
+            )
+
+        carry0 = (
+            jnp.zeros(mb_shape, x_mb_.dtype),            # fwd_msg
+            jnp.zeros(mb_shape, jnp.float32),            # bwd_msg
+            jnp.zeros((R,) + mb_shape, x_mb_.dtype),     # inbuf
+            f32_zeros_like(params_local),                # d_params
+            f32_zeros_like(last_params_),                # d_last
+            jnp.zeros(x_mb_.shape, jnp.float32),         # d_x
+            jnp.zeros((), jnp.float32),                  # ce_acc
+            jnp.zeros((), jnp.float32),                  # aux_acc
+        )
+
+        def tick(carry, t):
+            (fwd_msg, bwd_msg, inbuf, d_params, d_last, d_x,
+             ce_acc, aux_acc) = carry
+            # Tie this tick's (loop-invariant) param use to the carry:
+            # without the barrier, GSPMD's per-tick param all-gathers
+            # (fsdp/tensor axes) depend only on the invariant params, so
+            # XLA:CPU may start iteration k+1's all-gather while a peer
+            # is still in iteration k's ppermute — the rendezvous keys
+            # collide across iterations and the program deadlocks. TPU
+            # executes collectives in program order, so this only pins
+            # down an ordering the hardware imposes anyway.
+            (params_t, last_params_t), fwd_msg = (
+                jax.lax.optimization_barrier(
+                    ((params_local, last_params_), fwd_msg)
+                )
+            )
+            f = t - stage
+            b = t - 2 * (S - 1) + stage
+            valid_f = (f >= 0) & (f < M)
+            valid_b = (b >= 0) & (b < M)
+            fidx = jnp.clip(f, 0, M - 1)
+            bidx = jnp.clip(b, 0, M - 1)
+
+            cur = jnp.where(stage == 0, _idx(x_mb_, fidx), fwd_msg)
+            saved = _idx(inbuf, jnp.mod(bidx, R))
+            # save this tick's input; gate on valid_f or the clipped
+            # index would clobber slot 0 during bubbles
+            inbuf = jnp.where(
+                valid_f,
+                jax.lax.dynamic_update_index_in_dim(
+                    inbuf, cur, jnp.mod(fidx, R), 0
+                ),
+                inbuf,
+            )
+
+            # Every stage runs the SAME computation each tick (inputs/
+            # seeds selected by `where`) — divergent `lax.cond` branches
+            # deadlock because GSPMD inserts different resharding
+            # collectives per branch. The last stage's vjp microbatch is
+            # its fwd one (b == f there), so one vjp serves both roles.
+            vidx = jnp.where(is_last, fidx, bidx)
+            valid_v = jnp.where(is_last, valid_f, valid_b)
+            sx_f = tuple(_idx(e, fidx) for e in sx_mb_)
+            sx_v = tuple(_idx(e, vidx) for e in sx_mb_)
+            lx_v = tuple(_idx(e, vidx) for e in lx_mb_)
+            cur_v = jnp.where(is_last, cur, saved)
+
+            def stage_at_v(p_, c_):
+                return stage_fn(p_, c_, *sx_v)
+
+            (h_v, aux_v), stage_vjp = jax.vjp(
+                stage_at_v, params_t, cur_v
+            )
+            # head/loss vjp runs on every stage for uniformity; only the
+            # last stage's contribution is kept (the per-stage overhead
+            # matches the recompute GPipe-with-remat pays anyway)
+            ce, ce_vjp = jax.vjp(
+                lambda lp_, h_: last_fn(lp_, h_, *lx_v),
+                last_params_t, h_v,
+            )
+            d_lp, d_h_ce = ce_vjp(jnp.ones((), ce.dtype))
+            seed_h = jnp.where(
+                is_last, d_h_ce.astype(jnp.float32), bwd_msg
+            ).astype(h_v.dtype)
+            d_p, d_c = stage_vjp((seed_h, jnp.ones((), aux_v.dtype)))
+            out_chain, _aux_f = stage_fn(params_t, cur, *sx_f)
+
+            d_c = jnp.where(valid_v, d_c, 0).astype(jnp.float32)
+            d_params = jax.tree.map(
+                lambda acc, g: acc + jnp.where(valid_v, g, 0).astype(
+                    jnp.float32
+                ),
+                d_params, d_p,
+            )
+            d_last = jax.tree.map(
+                lambda acc, g: acc + jnp.where(
+                    is_last & valid_f, g, 0
+                ).astype(jnp.float32),
+                d_last, d_lp,
+            )
+            ce = jnp.where(is_last & valid_f, ce, 0.0).astype(
+                jnp.float32
+            )
+            aux = jnp.where(valid_v, aux_v, 0.0).astype(jnp.float32)
+            d_x = jnp.where(
+                valid_b & (stage == 0),
+                jax.lax.dynamic_update_index_in_dim(d_x, d_c, bidx, 0),
+                d_x,
+            )
+            ce_acc = ce_acc + ce
+            aux_acc = aux_acc + aux
+
+            fwd_msg = jax.lax.ppermute(
+                out_chain, AXIS, [(i, i + 1) for i in range(S - 1)]
+            )
+            # order the two permutes: they are data-independent, and
+            # XLA:CPU's thunk executor may start them in a different
+            # order on different devices — a rendezvous deadlock. The
+            # barrier makes the cotangent permute depend on the
+            # activation permute's completion.
+            d_c, fwd_msg = jax.lax.optimization_barrier((d_c, fwd_msg))
+            bwd_msg = jax.lax.ppermute(
+                d_c, AXIS, [(i, i - 1) for i in range(1, S)]
+            )
+            return (fwd_msg, bwd_msg, inbuf, d_params, d_last, d_x,
+                    ce_acc, aux_acc), None
+
+        (_, _, _, d_params, d_last, d_x, ce_acc, aux_acc), _ = (
+            jax.lax.scan(tick, carry0, jnp.arange(T))
+        )
+        # head grads live on the last stage only; psum replicates them
+        # (other stages hold zeros), d_x likewise from stage 0, and the
+        # scalars from their owners. Fuse everything into ONE psum of a
+        # flat f32 vector: one rendezvous, and no mutually-independent
+        # collectives the CPU thunk executor could reorder per device.
+        reduce_leaves, reduce_def = jax.tree.flatten(
+            (ce_acc, aux_acc, d_last, d_x)
+        )
+        sizes = [leaf.size for leaf in reduce_leaves]
+        flat = jnp.concatenate([leaf.ravel() for leaf in reduce_leaves])
+        flat = jax.lax.psum(flat, AXIS)
+        parts, off = [], 0
+        for leaf, size in zip(reduce_leaves, sizes):
+            parts.append(flat[off:off + size].reshape(leaf.shape))
+            off += size
+        ce_acc, aux_acc, d_last, d_x = jax.tree.unflatten(
+            reduce_def, parts
+        )
+        loss = (ce_acc + aux_acc) / M
+        d_params = jax.tree.map(
+            lambda g, p: (g / M).astype(p.dtype), d_params, params_local
+        )
+        d_last = jax.tree.map(
+            lambda g, p: (g / M).astype(p.dtype), d_last, last_params_
+        )
+        d_x = (d_x / M).astype(x_mb_.dtype)
+        return loss, d_params, d_last, d_x
+
+    def run_schedule(sp, lp, x_, sx, lx):
+        return get_shard_map()(
+            schedule,
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(lambda _: P(AXIS), sp),
+                jax.tree.map(lambda _: P(), lp),
+                P(),
+                jax.tree.map(lambda _: P(), sx),
+                jax.tree.map(lambda _: P(), lx),
+            ),
+            out_specs=(
+                P(),
+                jax.tree.map(lambda _: P(AXIS), sp),
+                jax.tree.map(lambda _: P(), lp),
+                P(),
+            ),
+            axis_names={AXIS},
+            check_vma=False,
+        )(sp, lp, x_, sx, lx)
+
+    def _zero_cotangent(a):
+        import numpy as np
+
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return jnp.zeros_like(a)
+        return np.zeros(a.shape, jax.dtypes.float0)
+
+    @jax.custom_vjp
+    def _loss(sp, lp, x_, sx, lx):
+        # non-differentiated primal (eval): forward-only GPipe schedule
+        # + per-microbatch head — the fused schedule would pay the whole
+        # backward for a loss that is never differentiated
+        out_mb, aux = pipeline_apply(
+            stage_fn, sp, x_.reshape((-1,) + x_.shape[2:]),
+            *tuple(e.reshape((-1,) + e.shape[2:]) for e in sx),
+            n_microbatches=M, mesh=mesh,
+        )
+        out_mb = out_mb.reshape(x_.shape)
+        ce = 0.0
+        for m in range(M):
+            ce = ce + last_fn(lp, out_mb[m], *(e[m] for e in lx))
+        return ce / M + aux
+
+    def _loss_fwd(sp, lp, x_, sx, lx):
+        out, d_sp, d_lp, d_x = run_schedule(sp, lp, x_, sx, lx)
+        return out, (d_sp, d_lp, d_x, sx, lx)
+
+    def _loss_bwd(res, ct):
+        d_sp, d_lp, d_x, sx, lx = res
+
+        def scale(tree):
+            return jax.tree.map(
+                lambda g: (ct * g.astype(jnp.float32)).astype(g.dtype),
+                tree,
+            )
+
+        return (
+            scale(d_sp),
+            scale(d_lp),
+            scale(d_x),
+            jax.tree.map(_zero_cotangent, sx),
+            jax.tree.map(_zero_cotangent, lx),
+        )
+
+    _loss.defvjp(_loss_fwd, _loss_bwd)
+    return _loss(stage_params, last_params, x_mb, sx_mb, lx_mb)
 
 
 def stage_layer_scan(

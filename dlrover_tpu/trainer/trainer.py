@@ -201,7 +201,18 @@ class Trainer:
                 break
         if restored is None:
             if first_err is not None:
-                raise first_err
+                if os.environ.get("DLROVER_TPU_IGNORE_CKPT"):
+                    logger.warning(
+                        "ignoring incompatible checkpoint "
+                        "(DLROVER_TPU_IGNORE_CKPT set): %s", first_err,
+                    )
+                    return 0
+                raise ValueError(
+                    f"existing checkpoint is incompatible with the "
+                    f"current model/optimizer layout: {first_err}. "
+                    f"Delete the checkpoint dir or set "
+                    f"DLROVER_TPU_IGNORE_CKPT=1 to start fresh."
+                ) from first_err
             return 0
         tree, step = restored
         if isinstance(tree, dict) and "train" in tree:

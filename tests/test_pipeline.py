@@ -25,7 +25,11 @@ from dlrover_tpu.parallel import (
     set_mesh,
 )
 from dlrover_tpu.parallel.mesh import _global_mesh  # noqa: F401
-from dlrover_tpu.parallel.pipeline import pipeline_apply, stage_layer_scan
+from dlrover_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_loss_1f1b,
+    stage_layer_scan,
+)
 
 
 @pytest.fixture(autouse=True)
@@ -139,6 +143,167 @@ def test_pipeline_bf16_grad():
         gs, gx = jax.jit(jax.grad(loss, argnums=(0, 1)))(scales, x)
     assert np.isfinite(np.asarray(gs, np.float32)).all()
     assert np.isfinite(np.asarray(gx, np.float32)).all()
+
+
+class Test1F1B:
+    """Loss-in-pipeline 1F1B schedule (reference default
+    Interleaved1F1B): loss and all grads must match the dense path, and
+    in-flight activation storage is bounded by depth by construction
+    (ring buffer of 2S-1 slots, independent of M)."""
+
+    def _problem(self, L=8, B=8, D=16):
+        rs = np.random.RandomState(0)
+        scales = jnp.asarray(rs.randn(L, D).astype(np.float32) * 0.1 + 1)
+        head = jnp.asarray(rs.randn(D).astype(np.float32))
+        x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+
+        def layer_fn(h, scale):
+            return h * scale + 1.0, jnp.mean(h**2).astype(
+                jnp.float32
+            ) * 0.01
+
+        stage_fn = stage_layer_scan(layer_fn, remat=False)
+
+        def last_fn(lp, h):
+            return jnp.mean((h @ lp) ** 2)
+
+        def loss_ref(s, lp, x):
+            h, aux = x, 0.0
+            for l in range(L):
+                aux = aux + jnp.mean(h**2) * 0.01
+                h = h * s[l] + 1.0
+            return jnp.mean((h @ lp) ** 2) + aux
+
+        return stage_fn, last_fn, loss_ref, scales, head, x
+
+    @pytest.mark.parametrize("pipe,m", [(2, 4), (4, 8), (4, 4)])
+    def test_matches_dense(self, pipe, m):
+        stage_fn, last_fn, loss_ref, scales, head, x = self._problem()
+        mesh = build_mesh(MeshConfig(pipe=pipe, data=8 // pipe))
+        set_mesh(mesh)
+
+        def loss_pp(s, lp, x):
+            return pipeline_loss_1f1b(
+                stage_fn, last_fn, s, lp, x, n_microbatches=m
+            )
+
+        with mesh:
+            val = jax.jit(loss_pp)(scales, head, x)
+            g_s, g_h, g_x = jax.jit(
+                jax.grad(loss_pp, argnums=(0, 1, 2))
+            )(scales, head, x)
+        np.testing.assert_allclose(
+            float(val), float(loss_ref(scales, head, x)), rtol=1e-5
+        )
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(scales, head, x)
+        for got, want in zip((g_s, g_h, g_x), gr):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=1e-6
+            )
+
+    def test_microbatch_extras(self):
+        """stage/last extras are microbatched and reach the right
+        microbatch (int extras get zero cotangents)."""
+        mesh = build_mesh(MeshConfig(pipe=2, data=4))
+        set_mesh(mesh)
+        L, B, D, M = 4, 8, 8, 4
+        scales = jnp.ones((L, D))
+        x = jnp.ones((B, D))
+        marks = jnp.arange(B, dtype=jnp.int32)  # per-sample marker
+
+        def layer_fn(h, scale, mark):
+            return h * scale + mark[:, None].astype(h.dtype), jnp.zeros(
+                (), jnp.float32
+            )
+
+        stage_fn = stage_layer_scan(layer_fn, remat=False)
+
+        def last_fn(lp, h, mark):
+            return jnp.mean(h * mark[:, None].astype(h.dtype))
+
+        def loss_pp(s, x):
+            return pipeline_loss_1f1b(
+                stage_fn, last_fn, s, jnp.zeros(()), x,
+                stage_extras=(marks,), last_extras=(marks,),
+                n_microbatches=M,
+            )
+
+        def loss_ref(s, x):
+            h = x
+            for l in range(L):
+                h = h * s[l] + marks[:, None].astype(h.dtype)
+            # mean-of-microbatch-means == global mean (equal sizes)
+            return jnp.mean(h * marks[:, None].astype(h.dtype))
+
+        with mesh:
+            val, grad = jax.jit(
+                jax.value_and_grad(loss_pp)
+            )(scales, x)
+        np.testing.assert_allclose(
+            float(val), float(loss_ref(scales, x)), rtol=1e-5
+        )
+        g_ref = jax.grad(loss_ref)(scales, x)
+        np.testing.assert_allclose(
+            np.asarray(grad), np.asarray(g_ref), rtol=2e-4, atol=1e-6
+        )
+
+
+def test_llama_1f1b_matches_gpipe_loss():
+    """The llama training loss through the 1f1b schedule equals the
+    gpipe-path loss (all tokens valid -> mean-of-means == global mean)
+    and its grads match."""
+    base = dict(
+        vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=32, attn_impl="reference", remat=False,
+        dtype="float32", pipe_microbatches=4,
+    )
+    cfg_g = LlamaConfig(**base)
+    cfg_f = LlamaConfig(**base, pipe_schedule="1f1b")
+    params = llama_init(cfg_g, jax.random.key(0))
+    batch = {"tokens": jax.random.randint(jax.random.key(1), (8, 17), 0, 64)}
+
+    mesh = build_mesh(MeshConfig(pipe=2, data=2, fsdp=2))
+    set_mesh(mesh)
+    with mesh:
+        lg, gg = jax.jit(jax.value_and_grad(
+            lambda p: llama_loss_fn(cfg_g)(p, batch, None)
+        ))(params)
+        lf, gf = jax.jit(jax.value_and_grad(
+            lambda p: llama_loss_fn(cfg_f)(p, batch, None)
+        ))(params)
+    np.testing.assert_allclose(float(lf), float(lg), rtol=1e-5)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(gg)[0][:8],
+        jax.tree_util.tree_flatten_with_path(gf)[0][:8],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=5e-3, atol=1e-5,
+            err_msg=str(path),
+        )
+
+
+def test_auto_accelerate_1f1b_train_step():
+    config = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=32, attn_impl="reference", remat=False,
+        dtype="float32", pipe_microbatches=2, pipe_schedule="1f1b",
+    )
+    strategy = Strategy(
+        mesh=MeshConfig(pipe=2, data=2, fsdp=2),
+        compute_dtype=None, remat="none",
+    )
+    result = auto_accelerate(
+        loss_fn=llama_loss_fn(config),
+        init_fn=lambda rng: llama_init(config, rng),
+        optimizer=optax.adam(1e-3),
+        param_logical_axes=llama_logical_axes(config),
+        strategy=strategy,
+    )
+    batch = {"tokens": jax.random.randint(jax.random.key(2), (8, 17), 0, 64)}
+    state, metrics = result.train_step(result.state, batch, jax.random.key(3))
+    assert np.isfinite(float(metrics["loss"]))
+    state, m2 = result.train_step(state, batch, jax.random.key(4))
+    assert np.isfinite(float(m2["loss"]))
 
 
 def test_auto_accelerate_with_pipe_axis():
