@@ -44,6 +44,25 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger(__name__)
 
 
+def apply_compilation_cache_env(cache_dir: str, env: dict) -> dict:
+    """Point a worker env at the persistent XLA compilation cache.
+
+    User-provided values win; the thresholds drop to "cache everything"
+    so a restarted worker replays every program from cache instead of
+    recompiling (the recompile-after-membership-change cost is the
+    goodput sink the cache exists to remove)."""
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        env.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0"
+        )
+        env.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1"
+        )
+    return env
+
+
 @dataclasses.dataclass
 class ElasticLaunchConfig:
     """Launch configuration (reference ElasticLaunchConfig :107)."""
@@ -68,6 +87,11 @@ class ElasticLaunchConfig:
     accelerator: str = "tpu"
     log_dir: str | None = None
     run_id: str = "dlrover-tpu"
+    # persistent XLA compilation cache shared across worker restarts:
+    # elastic membership changes restart worker processes with a new
+    # mesh, and the recompile must be a cache hit or it eats the goodput
+    # the flash checkpoint bought (SURVEY hard-parts list). "" disables.
+    compilation_cache_dir: str = "/tmp/dlrover_tpu/compile_cache"
 
     def auto_configure_params(self):
         """--auto-config: infer process count from visible devices."""
@@ -254,6 +278,9 @@ class ElasticTrainingAgent:
                 ConfigPath.ENV_PARAL_CONFIG: ConfigPath.PARAL_CONFIG,
                 ConfigPath.ENV_RUNTIME_METRICS: ConfigPath.RUNTIME_METRICS,
             }
+        )
+        apply_compilation_cache_env(
+            self._config.compilation_cache_dir, env
         )
         return env
 

@@ -409,7 +409,10 @@ def _llama_1f1b_loss(config: LlamaConfig, params, tokens):
     """Training loss through the 1F1B schedule: the final norm + head +
     CE run as the pipeline's last stage (loss-in-pipeline), bounding
     in-flight microbatch activations by the pipeline depth."""
-    from dlrover_tpu.parallel.pipeline import pipeline_loss_1f1b
+    from dlrover_tpu.parallel.pipeline import (
+        pipe_size,
+        pipeline_loss_1f1b,
+    )
 
     dtype = jnp.dtype(config.dtype)
     inputs, labels = tokens[:, :-1], tokens[:, 1:]
@@ -418,11 +421,19 @@ def _llama_1f1b_loss(config: LlamaConfig, params, tokens):
     x = params["embed"].astype(dtype)[inputs]
     x = shard_logical(x, ("batch", "seq", "embed"))
 
+    # Global valid-token normalizer, computed from the labels BEFORE the
+    # schedule: per-microbatch normalization would weight tokens in
+    # sparsely-valid microbatches more than the dense/gpipe objective.
+    # Each last_fn returns loss_sum * M / total_valid so the schedule's
+    # /M yields exactly sum(loss) / total_valid.
+    M = config.pipe_microbatches or 2 * pipe_size()
+    valid_total = jnp.maximum((labels != -100).sum(), 1)
+
     def last_fn(lp, h, labels_mb):
         h = _rms_norm(h, lp["final_norm"], config.norm_eps)
         logits = (h @ lp["lm_head"].astype(dtype)).astype(jnp.float32)
-        loss, valid = softmax_cross_entropy(logits, labels_mb)
-        return loss.sum() / jnp.maximum(valid.sum(), 1)
+        loss, _valid = softmax_cross_entropy(logits, labels_mb)
+        return loss.sum() * (M / valid_total)
 
     last_params = {
         "final_norm": params["final_norm"],

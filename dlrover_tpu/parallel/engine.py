@@ -44,10 +44,21 @@ class ModelAnalysis:
     n_layers: int = 0
     moe: bool = False
     n_experts: int = 1
+    hidden: int = 0  # model width (activation feature dim)
 
 
 def analyse_params(params) -> ModelAnalysis:
-    """Derive ModelAnalysis from a params pytree (or its eval_shape)."""
+    """Derive ModelAnalysis from a params pytree (or its eval_shape).
+
+    ``hidden`` is inferred structurally instead of hard-coded: for each
+    weight matrix the smaller of its two trailing dims is a candidate
+    for the residual width (projections map hidden->heads/mlp and back,
+    so hidden shows up on one side of nearly every matmul); the modal
+    candidate wins. Callers can still override via the estimator's
+    ``hidden=`` argument.
+    """
+    import collections
+
     import jax
     import numpy as np
 
@@ -55,6 +66,7 @@ def analyse_params(params) -> ModelAnalysis:
     count = 0
     bytes_ = 0
     largest = 0
+    width_votes: collections.Counter = collections.Counter()
     for leaf in leaves:
         shape = getattr(leaf, "shape", None)
         if shape is None:
@@ -64,17 +76,21 @@ def analyse_params(params) -> ModelAnalysis:
         itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
         bytes_ += n * itemsize
         largest = max(largest, n)
+        if len(shape) >= 2:
+            width_votes[int(min(shape[-2], shape[-1]))] += 1
     # stacked-layer detection: a leading dim shared by many leaves
     n_layers = 0
     for leaf in leaves:
         shape = getattr(leaf, "shape", ())
         if len(shape) >= 3:
             n_layers = max(n_layers, shape[0])
+    hidden = width_votes.most_common(1)[0][0] if width_votes else 0
     return ModelAnalysis(
         param_count=count,
         param_bytes=bytes_,
         largest_layer_params=largest,
         n_layers=n_layers,
+        hidden=hidden,
     )
 
 
@@ -88,13 +104,17 @@ def estimate_hbm_per_device(
     strategy: Strategy,
     batch_per_device: int = 8,
     seq_len: int = 2048,
-    hidden: int = 4096,
+    hidden: int | None = None,
 ) -> float:
     """Rough bytes/device: params + grads + Adam state + activations.
 
     Model-state is sharded by fsdp×tensor×expert (GSPMD ZeRO-3 analogue);
-    activations by data×fsdp×seq with remat discounts.
+    activations by data×fsdp×seq with remat discounts. ``hidden``
+    defaults to the width inferred by :func:`analyse_params` so the
+    activation term tracks the actual model instead of a fixed 4096.
     """
+    if hidden is None:
+        hidden = analysis.hidden or 4096
     m = strategy.mesh
     model_shard = max(m.fsdp * m.tensor * m.expert * m.pipe, 1)
     # fp32 master params + grads + 2x Adam moments
@@ -134,7 +154,7 @@ def candidate_strategies(
     hbm_gb: float = 16.0,
     seq_len: int = 2048,
     batch_per_device: int = 8,
-    hidden: int = 4096,
+    hidden: int | None = None,
     max_candidates: int = 16,
     allow_pipe: bool = True,
 ) -> list[Strategy]:
@@ -285,6 +305,133 @@ class DryRunner:
 
 
 # --------------------------------------------------------------------------
+# Bayesian-optimization search generator
+# (reference atorch/auto/engine/sg_algo/bayes_opt_sg.py with its vendored
+#  HEBO — TPU redesign: a small numpy Gaussian process + expected
+#  improvement over the discrete candidate space, step time from the
+#  dry-runner as the objective; no vendored library needed)
+# --------------------------------------------------------------------------
+
+
+def _strategy_features(s: Strategy):
+    """Embed a candidate in R^7 for the GP kernel: log2 mesh dims +
+    remat ordinal (scaled so one mesh-halving ~ one remat level)."""
+    import math
+
+    m = s.mesh
+    remat_ord = {"none": 0.0, "minimal": 1.0, "full": 2.0}.get(
+        s.remat, 1.0
+    )
+    return [
+        math.log2(max(m.data, 1)),
+        math.log2(max(m.fsdp, 1)),
+        math.log2(max(m.tensor, 1)),
+        math.log2(max(m.pipe, 1)),
+        math.log2(max(m.seq, 1)),
+        math.log2(max(m.expert, 1)),
+        remat_ord,
+    ]
+
+
+class BayesianSearch:
+    """GP + expected-improvement over a discrete candidate list.
+
+    Candidates arrive cost-model-ordered (best guess first), which seeds
+    the search: the first ``n_seed`` evaluations take the top-ranked and
+    the most-distant candidate, then EI picks each next dry-run. Failed
+    dry-runs feed back as a large penalty so the GP steers away from
+    that region instead of retrying neighbours.
+    """
+
+    def __init__(self, candidates: list[Strategy], n_seed: int = 2,
+                 noise: float = 1e-6, length_scale: float = 1.5):
+        import numpy as np
+
+        self._candidates = list(candidates)
+        self._X = np.asarray(
+            [_strategy_features(s) for s in self._candidates], float
+        )
+        self._observed: dict[int, float] = {}
+        self._failed: set[int] = set()
+        self._noise = noise
+        self._ls = length_scale
+        self._seed_order = self._make_seed_order(n_seed)
+
+    def _make_seed_order(self, n_seed: int) -> list[int]:
+        import numpy as np
+
+        if not self._candidates:
+            return []
+        order = [0]
+        if n_seed > 1 and len(self._candidates) > 1:
+            d = np.linalg.norm(self._X - self._X[0], axis=1)
+            order.append(int(d.argmax()))
+        return order[:n_seed]
+
+    def _kernel(self, A, B):
+        import numpy as np
+
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self._ls**2))
+
+    def suggest(self, exclude=()) -> int | None:
+        """Index of the next candidate to dry-run (None = exhausted).
+        ``exclude``: indices already handed out but not yet observed
+        (in-flight dry-runs in the task-loop API)."""
+        import numpy as np
+
+        skip = set(self._observed) | set(exclude)
+        unobserved = [
+            i for i in range(len(self._candidates)) if i not in skip
+        ]
+        if not unobserved:
+            return None
+        for i in self._seed_order:
+            if i not in skip:
+                return i
+        obs_idx = sorted(self._observed)
+        X_o = self._X[obs_idx]
+        y = np.asarray([self._observed[i] for i in obs_idx], float)
+        y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+        y_n = (y - y_mean) / y_std
+        K = self._kernel(X_o, X_o) + self._noise * np.eye(len(obs_idx))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y_n))
+        X_u = self._X[unobserved]
+        K_s = self._kernel(X_u, X_o)
+        mu = K_s @ alpha
+        v = np.linalg.solve(L, K_s.T)
+        var = np.clip(1.0 - (v**2).sum(0), 1e-12, None)
+        sigma = np.sqrt(var)
+        # expected improvement (minimization)
+        best = y_n.min()
+        z = (best - mu) / sigma
+        from math import erf, sqrt
+
+        cdf = 0.5 * (1.0 + np.vectorize(erf)(z / sqrt(2.0)))
+        pdf = np.exp(-0.5 * z**2) / np.sqrt(2 * np.pi)
+        ei = (best - mu) * cdf + sigma * pdf
+        return unobserved[int(ei.argmax())]
+
+    def observe(self, index: int, step_s: float, ok: bool = True):
+        if not ok:
+            worst = max(self._observed.values(), default=1.0)
+            step_s = max(worst * 10.0, 1.0)
+            self._failed.add(index)
+        self._observed[index] = float(step_s)
+
+    def best(self) -> int | None:
+        """Best *successful* observation (failures only steer the GP)."""
+        ok_obs = {
+            i: v for i, v in self._observed.items()
+            if i not in self._failed
+        }
+        if not ok_obs:
+            return None
+        return min(ok_obs, key=ok_obs.get)
+
+
+# --------------------------------------------------------------------------
 # engine + task loop (reference engine/executor.py task states)
 # --------------------------------------------------------------------------
 
@@ -325,18 +472,29 @@ class StrategySearchEngine:
         hbm_gb: float = 16.0,
         seq_len: int = 2048,
         max_dryruns: int = 6,
+        search_algo: str = "greedy",
         **candidate_kwargs,
     ):
+        if search_algo not in ("greedy", "bo"):
+            raise ValueError(
+                f"search_algo must be 'greedy' or 'bo', got {search_algo!r}"
+            )
         self._n_devices = n_devices
         self._analysis = analysis
         self._dry_runner = dry_runner
         self._max_dryruns = max_dryruns
+        self._algo = search_algo
         self._candidates = candidate_strategies(
             n_devices, analysis, devices_per_host=devices_per_host,
             hbm_gb=hbm_gb, seq_len=seq_len, **candidate_kwargs,
         )
+        self._bo = (
+            BayesianSearch(self._candidates) if search_algo == "bo"
+            else None
+        )
         self._results: list[DryRunResult] = []
         self._cursor = 0
+        self._pending: set[int] = set()
         self._finished = False
 
     @property
@@ -350,7 +508,13 @@ class StrategySearchEngine:
     # -------------------------------------------------------- synchronous
 
     def search(self) -> Strategy:
-        """Dry-run the top candidates; fastest feasible step wins."""
+        """Dry-run candidates; fastest feasible step wins.
+
+        ``search_algo="greedy"`` profiles the cost-model top-N in order;
+        ``"bo"`` lets the GP/EI loop pick each next dry-run, typically
+        reaching the optimum in fewer compiles on large candidate spaces
+        (reference bayes_opt_sg.py capability).
+        """
         if not self._candidates:
             logger.warning("no feasible candidates; heuristic fallback")
             return auto_strategy(
@@ -358,8 +522,18 @@ class StrategySearchEngine:
             )
         if self._dry_runner is None:
             return self._candidates[0]
-        for s in self._candidates[: self._max_dryruns]:
-            self._results.append(self._dry_runner.profile(s))
+        if self._algo == "bo":
+            for _ in range(min(self._max_dryruns,
+                               len(self._candidates))):
+                idx = self._bo.suggest()
+                if idx is None:
+                    break
+                r = self._dry_runner.profile(self._candidates[idx])
+                self._results.append(r)
+                self._bo.observe(idx, r.step_s, r.ok)
+        else:
+            for s in self._candidates[: self._max_dryruns]:
+                self._results.append(self._dry_runner.profile(s))
         ok = [r for r in self._results if r.ok]
         if not ok:
             logger.warning("all dry-runs failed; using top candidate")
@@ -375,21 +549,32 @@ class StrategySearchEngine:
     # ---------------------------------------------------------- task loop
 
     def get_task(self) -> EngineTask:
+        """Task IDs are candidate indices (both algorithms), so
+        ``report_task_result`` can feed the BO observer."""
         if self._finished:
             return EngineTask(TaskType.FINISH, self.best_strategy())
-        if self._cursor >= min(len(self._candidates), self._max_dryruns):
+        issued = self._cursor
+        if issued >= min(len(self._candidates), self._max_dryruns):
             self._finished = True
             return EngineTask(TaskType.FINISH, self.best_strategy())
-        task = EngineTask(
-            TaskType.DRYRUN,
-            self._candidates[self._cursor],
-            task_id=self._cursor,
-        )
+        if self._bo is not None:
+            idx = self._bo.suggest(exclude=self._pending)
+            if idx is None:
+                self._finished = True
+                return EngineTask(TaskType.FINISH, self.best_strategy())
+        else:
+            idx = self._cursor
+        self._pending.add(idx)
         self._cursor += 1
-        return task
+        return EngineTask(
+            TaskType.DRYRUN, self._candidates[idx], task_id=idx
+        )
 
     def report_task_result(self, task_id: int, result: DryRunResult):
         self._results.append(result)
+        self._pending.discard(task_id)
+        if self._bo is not None and 0 <= task_id < len(self._candidates):
+            self._bo.observe(task_id, result.step_s, result.ok)
 
     def best_strategy(self) -> Strategy:
         ok = [r for r in self._results if r.ok]

@@ -62,6 +62,17 @@ def parse_args(argv=None):
              "min before forming the world",
     )
     parser.add_argument("--log-dir", type=str, default=None)
+    parser.add_argument(
+        "--compilation-cache-dir",
+        type=str,
+        default=os.environ.get(
+            "DLROVER_TPU_COMPILE_CACHE",
+            "/tmp/dlrover_tpu/compile_cache",
+        ),
+        help="persistent XLA compilation cache shared across worker "
+             "restarts (elastic restarts recompile from cache); "
+             "pass '' to disable",
+    )
     parser.add_argument("training_script", type=str)
     parser.add_argument(
         "training_script_args", nargs=argparse.REMAINDER
@@ -147,6 +158,7 @@ def run(args) -> int:
         rdzv_timeout=args.rdzv_timeout,
         rdzv_elastic_wait=args.rdzv_elastic_wait,
         log_dir=args.log_dir,
+        compilation_cache_dir=args.compilation_cache_dir,
     )
     script_args = list(args.training_script_args)
     if script_args and script_args[0] == "--":

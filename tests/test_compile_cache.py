@@ -1,0 +1,106 @@
+"""Persistent XLA compilation cache across worker restarts.
+
+SURVEY hard-parts list: elastic membership changes restart workers with
+a new mesh; the recompile must be (mostly) a cache hit or it eats the
+goodput the flash checkpoint bought. Reference analogue: the restarted
+torch workers reuse NCCL/torch caches; the TPU equivalent is the JAX
+persistent compilation cache wired by tpu-run into every worker env.
+"""
+
+import os
+import subprocess
+import sys
+
+from dlrover_tpu.agent.training_agent import (
+    ElasticLaunchConfig,
+    apply_compilation_cache_env,
+)
+
+
+class TestCacheEnv:
+    def test_env_vars_set(self, tmp_path):
+        env = apply_compilation_cache_env(str(tmp_path / "cc"), {})
+        assert env["JAX_COMPILATION_CACHE_DIR"] == str(tmp_path / "cc")
+        assert env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.0"
+        assert env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] == "-1"
+        assert (tmp_path / "cc").is_dir()
+
+    def test_user_env_wins(self, tmp_path):
+        env = apply_compilation_cache_env(
+            str(tmp_path / "cc"), {"JAX_COMPILATION_CACHE_DIR": "/else"}
+        )
+        assert env["JAX_COMPILATION_CACHE_DIR"] == "/else"
+
+    def test_empty_disables(self):
+        env = apply_compilation_cache_env("", {})
+        assert "JAX_COMPILATION_CACHE_DIR" not in env
+
+    def test_default_on_in_launch_config(self):
+        assert ElasticLaunchConfig().compilation_cache_dir
+
+
+_COMPILE_SCRIPT = r"""
+import time
+import jax
+import jax.numpy as jnp
+
+def layer(h, w):
+    a = jnp.tanh(h @ w) + h * jax.nn.sigmoid(h @ w.T).mean()
+    b = jax.nn.softmax(a @ w, axis=-1) @ h
+    c = jnp.where(b > 0, jnp.log1p(jnp.abs(b)), jnp.expm1(b))
+    return a + 0.1 * c, None
+
+def step(params, x):
+    h, _ = jax.lax.scan(layer, x, params)
+    g = jax.grad(lambda p: jax.lax.scan(layer, x, p)[0].sum())(params)
+    h2, _ = jax.lax.scan(layer, h.T, params)
+    return h.sum() + h2.mean() + sum(
+        jnp.sum(v) for v in jax.tree.leaves(g)
+    )
+
+params = jnp.ones((8, 256, 256))
+x = jnp.ones((256, 256))
+t0 = time.perf_counter()
+compiled = jax.jit(step).lower(params, x).compile()
+print(f"COMPILE_S={time.perf_counter() - t0:.4f}")
+"""
+
+
+class TestRestartRecompileFromCache:
+    def test_second_compile_much_faster(self, tmp_path):
+        """Two fresh processes (a simulated worker restart): the second
+        must compile >=10x faster by replaying the persistent cache."""
+        cache = str(tmp_path / "cc")
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        apply_compilation_cache_env(cache, env)
+
+        def run_once():
+            out = subprocess.run(
+                [sys.executable, "-c", _COMPILE_SCRIPT],
+                env=env, capture_output=True, text=True, timeout=300,
+            )
+            assert out.returncode == 0, out.stderr[-2000:]
+            for line in out.stdout.splitlines():
+                if line.startswith("COMPILE_S="):
+                    return float(line.split("=")[1])
+            raise AssertionError(f"no timing in output: {out.stdout}")
+
+        cold = run_once()
+        entries = set(os.listdir(cache))
+        assert entries, "cache dir empty after first compile"
+        warm = run_once()
+        # the warm path still pays cache *deserialization* (scales with
+        # program size), so the wall-clock ratio saturates below the
+        # raw compile ratio; require 5x plus proof of an actual hit:
+        # the second run must not write any new cache entries
+        assert warm < cold / 5, (
+            f"expected >=5x faster from cache, got cold={cold:.3f}s "
+            f"warm={warm:.3f}s"
+        )
+        assert set(os.listdir(cache)) == entries, (
+            "second run recompiled (new cache entries) instead of "
+            "hitting the cache"
+        )

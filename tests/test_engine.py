@@ -9,6 +9,7 @@ import numpy as np
 import optax
 
 from dlrover_tpu.parallel.engine import (
+    BayesianSearch,
     DryRunner,
     DryRunResult,
     ModelAnalysis,
@@ -19,6 +20,7 @@ from dlrover_tpu.parallel.engine import (
     estimate_hbm_per_device,
     search_strategy,
     _factorizations,
+    _strategy_features,
 )
 from dlrover_tpu.parallel.strategy import Strategy
 
@@ -89,6 +91,161 @@ class TestCandidates:
         a = small_analysis(moe=True, n_experts=8)
         cands = candidate_strategies(8, a, hbm_gb=1024.0)
         assert any(s.mesh.expert > 1 for s in cands)
+
+
+class TestHiddenInference:
+    def test_infers_width_from_params(self):
+        """1k-hidden and 8k-hidden models must yield different HBM
+        estimates and feasibility sets (regression: a hard-coded
+        hidden=4096 made the activation term model-independent)."""
+        def make_params(d):
+            return {
+                "embed": jnp.zeros((512, d)),
+                "layers": {
+                    "wq": jnp.zeros((4, d, d)),
+                    "mlp": jnp.zeros((4, d, 4 * d)),
+                    "norm": jnp.zeros((4, d)),
+                },
+            }
+
+        a1k = analyse_params(make_params(1024))
+        a8k = analyse_params(make_params(8192))
+        assert a1k.hidden == 1024
+        assert a8k.hidden == 8192
+        s = Strategy()
+        e1k = estimate_hbm_per_device(a1k, s)
+        e8k = estimate_hbm_per_device(a8k, s)
+        assert e8k > e1k * 4  # activation term scales with real width
+
+    def test_feasibility_differs_by_width(self):
+        def make_params(d, layers=32):
+            return {
+                "layers": {
+                    "wq": jnp.zeros((layers, d, d)),
+                    "mlp": jnp.zeros((layers, d, 4 * d)),
+                },
+            }
+
+        a1k = analyse_params(make_params(1024))
+        a8k = analyse_params(make_params(8192))
+        # HBM sized so wide-model activations dominate: the narrow model
+        # keeps remat="none" candidates that the wide model must drop
+        c1k = candidate_strategies(8, a1k, hbm_gb=4.0, batch_per_device=8)
+        c8k = candidate_strategies(8, a8k, hbm_gb=4.0, batch_per_device=8)
+        r1k = {(s.mesh.fsdp, s.mesh.data, s.remat) for s in c1k}
+        r8k = {(s.mesh.fsdp, s.mesh.data, s.remat) for s in c8k}
+        assert r1k != r8k
+
+    def test_estimator_accepts_override(self):
+        a = small_analysis()
+        s = Strategy()
+        assert estimate_hbm_per_device(a, s, hidden=8192) > \
+            estimate_hbm_per_device(a, s, hidden=1024)
+
+
+class TestBayesianSearch:
+    def _candidates(self):
+        return candidate_strategies(
+            64, small_analysis(n_layers=32), hbm_gb=1024.0,
+            devices_per_host=8, max_candidates=16,
+        )
+
+    def test_finds_best_in_fewer_dryruns_than_exhaustive(self):
+        """A synthetic objective with its optimum NOT at the cost-model
+        top: BO must locate it within half the candidate-count budget."""
+        cands = self._candidates()
+        assert len(cands) >= 8
+
+        def true_step_time(s):
+            # parabola in log2(fsdp) with optimum at fsdp=8, mild
+            # penalties elsewhere — deliberately disagrees with the
+            # cost-model ranking (which favours fsdp=64)
+            f = _strategy_features(s)
+            return (
+                0.1 + 0.02 * (f[1] - 3.0) ** 2 + 0.05 * f[2]
+                + 0.08 * f[3] + 0.03 * f[6]
+            )
+
+        best_true = min(cands, key=true_step_time)
+        assert cands.index(best_true) != 0  # not the greedy top pick
+
+        bo = BayesianSearch(cands)
+        budget = len(cands) // 2
+        evals = 0
+        for _ in range(budget):
+            idx = bo.suggest()
+            if idx is None:
+                break
+            bo.observe(idx, true_step_time(cands[idx]))
+            evals += 1
+        assert evals <= budget
+        found = cands[bo.best()]
+        assert found == best_true, (
+            f"BO found {found.describe()} not {best_true.describe()} "
+            f"in {evals} evals"
+        )
+
+    def test_failed_candidates_penalized(self):
+        cands = self._candidates()
+        bo = BayesianSearch(cands)
+        i0 = bo.suggest()
+        bo.observe(i0, 0.0, ok=False)
+        i1 = bo.suggest()
+        assert i1 != i0
+        bo.observe(i1, 0.2)
+        assert bo.best() == i1
+
+    def test_task_loop_uses_bo(self):
+        """The async task loop must feed the GP too (task ids are
+        candidate indices), not silently fall back to greedy order."""
+        engine = StrategySearchEngine(
+            64, small_analysis(n_layers=32), devices_per_host=8,
+            hbm_gb=1024.0, max_dryruns=4, search_algo="bo",
+            max_candidates=16,
+        )
+        seen = []
+        while True:
+            t = engine.get_task()
+            if t.task_type == TaskType.FINISH:
+                break
+            seen.append(t.task_id)
+            engine.report_task_result(
+                t.task_id,
+                DryRunResult(t.strategy,
+                             step_s=sum(_strategy_features(t.strategy))),
+            )
+        assert len(seen) == 4
+        assert len(engine._bo._observed) == 4
+        # second suggestion is the BO seed (most distant), not cursor 1
+        assert seen[1] != 1
+
+    def test_best_excludes_failed(self):
+        cands = self._candidates()
+        bo = BayesianSearch(cands)
+        bo.observe(0, 0.0, ok=False)   # penalty 10.0
+        bo.observe(1, 99.0)            # slow but real
+        assert bo.best() == 1
+
+    def test_engine_bo_mode(self):
+        cands_n = len(self._candidates())
+
+        class FakeRunner:
+            def __init__(self):
+                self.calls = 0
+
+            def profile(self, s):
+                self.calls += 1
+                return DryRunResult(s, step_s=sum(_strategy_features(s)))
+
+        runner = FakeRunner()
+        engine = StrategySearchEngine(
+            64, small_analysis(n_layers=32), dry_runner=runner,
+            devices_per_host=8, hbm_gb=1024.0, max_dryruns=5,
+            search_algo="bo", max_candidates=16,
+        )
+        best = engine.search()
+        assert isinstance(best, Strategy)
+        assert runner.calls <= 5 < cands_n
 
 
 class TestEstimate:

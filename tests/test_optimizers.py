@@ -104,6 +104,37 @@ class TestAGD:
                 float(updates["w"]), expected, rtol=1e-5
             )
 
+    def test_no_amsgrad_has_no_max_nu_slot(self):
+        opt = agd(1e-3)
+        state = opt.init({"w": jnp.ones((8,))})
+        assert state[0].max_nu == ()
+
+    def test_checkpoint_with_legacy_max_nu_still_restores(self, tmp_path):
+        """Checkpoints written when non-amsgrad AGD carried a
+        param-sized max_nu slot must keep restoring: leaf matching is
+        by name, so the extra leaves are simply ignored."""
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            ReplicatedCheckpointEngine,
+        )
+
+        opt = agd(1e-3)
+        params = {"w": jnp.ones((4,))}
+        state = opt.init(params)
+        legacy = state[0]._replace(
+            max_nu=jax.tree.map(jnp.zeros_like, params)
+        )
+        eng = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        assert eng.save_to_memory(
+            3, {"opt": (legacy,) + tuple(state[1:])}
+        )
+        restored, step = eng.load(target={"opt": state})
+        assert step == 3
+        assert restored["opt"][0].max_nu == ()
+        np.testing.assert_allclose(
+            np.asarray(restored["opt"][0].mu["w"]), 0.0
+        )
+        eng.close()
+
     def test_amsgrad_and_clip(self):
         opt = agd(1e-2, amsgrad=True, clip=0.1)
         params = {"w": jnp.zeros((4,))}

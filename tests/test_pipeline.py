@@ -282,6 +282,37 @@ def test_llama_1f1b_matches_gpipe_loss():
         )
 
 
+def test_llama_1f1b_padded_batch_matches_gpipe():
+    """With ignore_index padding unevenly spread across microbatches,
+    the 1f1b loss must still equal the gpipe/dense objective (global
+    valid-token normalization, not mean-of-microbatch-means)."""
+    base = dict(
+        vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=32, attn_impl="reference", remat=False,
+        dtype="float32", pipe_microbatches=4,
+    )
+    cfg_g = LlamaConfig(**base)
+    cfg_f = LlamaConfig(**base, pipe_schedule="1f1b")
+    params = llama_init(cfg_g, jax.random.key(0))
+    tokens = np.array(
+        jax.random.randint(jax.random.key(1), (8, 17), 0, 64)
+    )
+    # mask most of the first 4 samples (microbatches 0-1): uneven valid
+    tokens[:4, 9:] = -100
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    mesh = build_mesh(MeshConfig(pipe=2, data=2, fsdp=2))
+    set_mesh(mesh)
+    with mesh:
+        lg = jax.jit(
+            lambda p: llama_loss_fn(cfg_g)(p, batch, None)
+        )(params)
+        lf = jax.jit(
+            lambda p: llama_loss_fn(cfg_f)(p, batch, None)
+        )(params)
+    np.testing.assert_allclose(float(lf), float(lg), rtol=1e-5)
+
+
 def test_auto_accelerate_1f1b_train_step():
     config = LlamaConfig(
         vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
