@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from dlrover_tpu.ops.attention import flash_attention, mha_reference
 from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+from dlrover_tpu.ops.fp8 import qdot
 from dlrover_tpu.parallel.sharding import shard_logical
 
 
@@ -319,13 +320,13 @@ def _layer(config: LlamaConfig, x, layer_params, positions):
     h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
 
     y = _rms_norm(x, p["attn_norm"], config.norm_eps)
-    q = (y @ p["wq"].astype(dtype)).reshape(B, S, h, hd)
-    k = (y @ p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
-    v = (y @ p["wv"].astype(dtype)).reshape(B, S, kvh, hd)
+    q = qdot(y, p["wq"].astype(dtype)).reshape(B, S, h, hd)
+    k = qdot(y, p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
+    v = qdot(y, p["wv"].astype(dtype)).reshape(B, S, kvh, hd)
     q = _rope(q, positions, config.rope_theta)
     k = _rope(k, positions, config.rope_theta)
     attn = _attention(config, q, k, v).reshape(B, S, h * hd)
-    x = x + attn @ p["wo"].astype(dtype)
+    x = x + qdot(attn, p["wo"].astype(dtype))
     x = shard_logical(x, ("batch", "seq", "embed"))
 
     y = _rms_norm(x, p["mlp_norm"], config.norm_eps)
@@ -340,10 +341,10 @@ def _layer(config: LlamaConfig, x, layer_params, positions):
         aux = (config.moe_aux_weight * metrics["aux_loss"]
                + config.moe_z_weight * metrics["z_loss"])
     else:
-        gate = jax.nn.silu(y @ p["w_gate"].astype(dtype))
-        up = y @ p["w_up"].astype(dtype)
+        gate = jax.nn.silu(qdot(y, p["w_gate"].astype(dtype)))
+        up = qdot(y, p["w_up"].astype(dtype))
         mlp = shard_logical(gate * up, ("batch", "seq", "mlp"))
-        x = x + mlp @ p["w_down"].astype(dtype)
+        x = x + qdot(mlp, p["w_down"].astype(dtype))
         aux = jnp.zeros((), jnp.float32)
     return shard_logical(x, ("batch", "seq", "embed")), aux
 

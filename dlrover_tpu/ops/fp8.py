@@ -1,0 +1,167 @@
+"""fp8 matmul path: e4m3 forward operands, e5m2 gradients, per-tensor
+scaling.
+
+Equivalent capability: reference ``Fp8Optimization``
+(atorch/atorch/auto/opt_lib/amp_optimization.py:197, TransformerEngine-
+backed fp8 autocast). TPU redesign: a ``jax.custom_vjp`` dot whose
+operands are rounded through ``float8_e4m3fn`` (forward) /
+``float8_e5m2`` (output cotangent) with per-tensor scale factors, and
+whose accumulation stays bf16/f32 — XLA fuses the quantize/dequantize
+into the matmul epilogue, and on fp8-capable MXUs lowers the converted
+operands natively. Scaling comes in two flavours:
+
+- **current scaling** (default, used by the autocast path): scales are
+  computed from the operand's own amax in the same step. One fused
+  reduction per tensor; most accurate.
+- **delayed scaling** (:class:`Fp8History`, :func:`fp8_dot_delayed`):
+  scales come from an amax *history* window (TransformerEngine's
+  recipe) so quantization needs no same-step reduction; callers thread
+  the history state through their step like any other optimizer state.
+
+Models opt in by routing hot matmuls through :func:`qdot`, which is a
+plain ``a @ b`` unless :func:`fp8_autocast` (set by auto_accelerate for
+``Strategy.compute_dtype="fp8"``) is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+
+class _Flag:
+    enabled = False
+
+
+@contextlib.contextmanager
+def fp8_autocast(enabled: bool = True):
+    """Trace-time switch: ``qdot`` quantizes while this is active."""
+    prev = _Flag.enabled
+    _Flag.enabled = enabled
+    try:
+        yield
+    finally:
+        _Flag.enabled = prev
+
+
+def fp8_is_enabled() -> bool:
+    return _Flag.enabled
+
+
+def _amax_scale(x, fmax: float):
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    return jnp.maximum(amax, 1e-12) / fmax
+
+
+def quantize_e4m3(x, scale=None):
+    """Round through e4m3 with a per-tensor scale; returns (q, scale).
+    ``q`` is stored as float8_e4m3fn (memory savings are real when the
+    consumer keeps it in that dtype)."""
+    if scale is None:
+        scale = _amax_scale(x, E4M3_MAX)
+    q = (x.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def quantize_e5m2(x, scale=None):
+    if scale is None:
+        scale = _amax_scale(x, E5M2_MAX)
+    q = (x.astype(jnp.float32) / scale).astype(jnp.float8_e5m2)
+    return q, scale
+
+
+def _dq(q, scale, dtype):
+    return q.astype(jnp.float32).astype(dtype) * scale.astype(dtype)
+
+
+def _fp8_dot_impl(a, b, a_scale, b_scale):
+    """dot(round_e4m3(a), round_e4m3(b)) accumulated in the input dtype
+    (bf16 in, f32 accumulate via XLA's default for fp8-converted
+    operands)."""
+    out_dtype = jnp.promote_types(a.dtype, b.dtype)
+    qa, a_scale = quantize_e4m3(a, a_scale)
+    qb, b_scale = quantize_e4m3(b, b_scale)
+    return jnp.matmul(
+        _dq(qa, a_scale, out_dtype), _dq(qb, b_scale, out_dtype)
+    )
+
+
+@jax.custom_vjp
+def fp8_dot(a, b):
+    """``a @ b`` with both operands rounded through e4m3 (current
+    per-tensor scaling) and the backward cotangent through e5m2."""
+    return _fp8_dot_impl(a, b, None, None)
+
+
+def _fp8_dot_fwd(a, b):
+    return _fp8_dot_impl(a, b, None, None), (a, b)
+
+
+def _fp8_dot_bwd(res, g):
+    a, b = res
+    qg, g_scale = quantize_e5m2(g)
+    gd = _dq(qg, g_scale, g.dtype)
+    # grads use e5m2 cotangent x e4m3 residual operands
+    qa, a_scale = quantize_e4m3(a)
+    qb, b_scale = quantize_e4m3(b)
+    da = jnp.matmul(gd, _dq(qb, b_scale, g.dtype).swapaxes(-1, -2))
+    ad = _dq(qa, a_scale, g.dtype)
+    db = jnp.matmul(
+        ad.reshape(-1, ad.shape[-1]).T, gd.reshape(-1, gd.shape[-1])
+    ) if a.ndim > 2 else jnp.matmul(ad.swapaxes(-1, -2), gd)
+    return da.astype(a.dtype), db.astype(b.dtype)
+
+
+fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+def qdot(a, b):
+    """``a @ b``, quantized to fp8 when :func:`fp8_autocast` is active.
+
+    The flag is read at trace time, so wrapping the loss trace in the
+    context (auto_accelerate does this for compute_dtype="fp8") is
+    enough — no per-call state threading. Only the linear-layer shape
+    (2-D weight on the right) takes the fp8 path; anything else falls
+    through to the plain dot."""
+    if _Flag.enabled and getattr(b, "ndim", 0) == 2 and \
+            getattr(a, "ndim", 0) >= 2:
+        return fp8_dot(a, b)
+    return a @ b
+
+
+# ---------------------------------------------------------------------------
+# delayed scaling (TransformerEngine recipe)
+# ---------------------------------------------------------------------------
+
+
+class Fp8History(NamedTuple):
+    """Per-tensor amax history ring; scale = max(history)/fmax."""
+
+    amax_history: jnp.ndarray  # [window] f32
+    fmax: float
+
+    @classmethod
+    def create(cls, window: int = 16, fmax: float = E4M3_MAX):
+        return cls(jnp.zeros((window,), jnp.float32), fmax)
+
+    def scale(self):
+        amax = jnp.max(self.amax_history)
+        return jnp.where(amax > 0, amax, 1.0) / self.fmax
+
+    def update(self, x) -> "Fp8History":
+        amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        hist = jnp.roll(self.amax_history, 1).at[0].set(amax)
+        return self._replace(amax_history=hist)
+
+
+def fp8_dot_delayed(a, b, a_hist: Fp8History, b_hist: Fp8History):
+    """``a @ b`` with operand scales taken from amax *histories* (no
+    same-step amax reduction). Returns (out, new_a_hist, new_b_hist)."""
+    out = _fp8_dot_impl(a, b, a_hist.scale(), b_hist.scale())
+    return out, a_hist.update(a), b_hist.update(b)

@@ -199,18 +199,29 @@ def auto_accelerate(
 
     # ---- train step --------------------------------------------------------
     compute_dtype = strategy.compute_dtype
+    # fp8 (reference Fp8Optimization analogue): params/activations stay
+    # bf16; the model's qdot matmuls quantize operands to e4m3/e5m2
+    # while the fp8_autocast trace flag is up
+    use_fp8 = compute_dtype == "fp8"
+    cast_dtype = "bfloat16" if use_fp8 else compute_dtype
     inner_loss = _remat_wrap(loss_fn, strategy.remat)
     accum = max(int(strategy.grad_accum), 1)
 
     def microbatch_grads(params, batch, rng):
-        cparams = _compute_cast(params, compute_dtype)
-        if has_aux:
-            grad_fn = jax.value_and_grad(inner_loss, has_aux=True)
-            (loss, aux), grads = grad_fn(cparams, batch, rng)
-        else:
-            grad_fn = jax.value_and_grad(inner_loss)
-            loss, grads = grad_fn(cparams, batch, rng)
-            aux = {}
+        import contextlib
+
+        from dlrover_tpu.ops.fp8 import fp8_autocast
+
+        cparams = _compute_cast(params, cast_dtype)
+        ctx = fp8_autocast() if use_fp8 else contextlib.nullcontext()
+        with ctx:
+            if has_aux:
+                grad_fn = jax.value_and_grad(inner_loss, has_aux=True)
+                (loss, aux), grads = grad_fn(cparams, batch, rng)
+            else:
+                grad_fn = jax.value_and_grad(inner_loss)
+                loss, grads = grad_fn(cparams, batch, rng)
+                aux = {}
         grads = jax.tree.map(
             lambda g, p: g.astype(p.dtype), grads, params
         )

@@ -390,6 +390,10 @@ class BayesianSearch:
             if i not in skip:
                 return i
         obs_idx = sorted(self._observed)
+        if not obs_idx:
+            # seeds all in flight, nothing observed yet (concurrent
+            # task-loop callers): hand out cost-model order
+            return unobserved[0]
         X_o = self._X[obs_idx]
         y = np.asarray([self._observed[i] for i in obs_idx], float)
         y_mean, y_std = y.mean(), max(y.std(), 1e-9)
@@ -415,8 +419,14 @@ class BayesianSearch:
 
     def observe(self, index: int, step_s: float, ok: bool = True):
         if not ok:
-            worst = max(self._observed.values(), default=1.0)
-            step_s = max(worst * 10.0, 1.0)
+            # penalty anchored to the worst *successful* time so
+            # repeated failures don't compound 10x each and blow up the
+            # GP's normalization
+            ok_times = [
+                v for i, v in self._observed.items()
+                if i not in self._failed
+            ]
+            step_s = max(max(ok_times, default=1.0) * 10.0, 1.0)
             self._failed.add(index)
         self._observed[index] = float(step_s)
 

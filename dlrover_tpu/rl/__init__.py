@@ -8,7 +8,12 @@ from dlrover_tpu.rl.ppo_utils import (
 )
 from dlrover_tpu.rl.replay_buffer import ReplayBuffer
 from dlrover_tpu.rl.model_engine import ModelEngine, ModelSpec
-from dlrover_tpu.rl.ppo_trainer import PPOConfig, PPOTrainer, RLTrainer
+from dlrover_tpu.rl.ppo_trainer import (
+    LMPPOTrainer,
+    PPOConfig,
+    PPOTrainer,
+    RLTrainer,
+)
 
 __all__ = [
     "gae_advantages_and_returns",
@@ -21,6 +26,7 @@ __all__ = [
     "ModelEngine",
     "ModelSpec",
     "PPOConfig",
+    "LMPPOTrainer",
     "PPOTrainer",
     "RLTrainer",
 ]

@@ -1,0 +1,252 @@
+"""KV-cache incremental decoding for RL experience generation.
+
+Equivalent capability: reference
+atorch/atorch/rl/inference_backend/vllm_backend.py (a vLLM-backed
+generation engine feeding PPO rollouts) and the DS hybrid engine. TPU
+redesign: one jitted ``generate`` program — prefill writes the prompt's
+K/V into a *ring-buffer* cache, then a ``lax.scan`` of single-token
+decode steps samples the continuation. The cache is fixed-size
+``[L, B, C, KVH, hd]`` with per-slot absolute positions, so sequences
+longer than C keep a sliding window instead of reallocating (the
+vLLM-paging analogue for a static-shape compiler); GQA is native (the
+cache stores KVH heads, queries expand on read).
+
+No torch, no server: the actor's own sharded params are the weights,
+so there is no weight-sync step between training and rollouts (the
+reference's hybrid-engine problem disappears).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models.llama import (
+    LlamaConfig,
+    _rms_norm,
+    _rope,
+)
+
+logger = get_logger(__name__)
+
+
+class KVCache(NamedTuple):
+    """Ring-buffer cache: ``k``/``v`` are [L, B, C, KVH, hd]; ``pos``
+    holds each slot's absolute position (-1 = empty)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    pos: jnp.ndarray  # [C] int32
+
+
+def init_kv_cache(
+    config: LlamaConfig, batch: int, capacity: int, dtype=None
+) -> KVCache:
+    dtype = dtype or jnp.dtype(config.dtype)
+    shape = (
+        config.n_layers, batch, capacity, config.n_kv_heads,
+        config.head_dim,
+    )
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        pos=jnp.full((capacity,), -1, jnp.int32),
+    )
+
+
+def _cached_attention(config: LlamaConfig, q, ck, cv, cache_pos, q_pos):
+    """q: [B, S, H, hd] (roped); ck/cv: [B, C, KVH, hd]; causal over the
+    cache's absolute positions."""
+    B, S, H, hd = q.shape
+    rep = H // config.n_kv_heads
+    k = jnp.repeat(ck, rep, axis=2)  # [B, C, H, hd]
+    v = jnp.repeat(cv, rep, axis=2)
+    scores = jnp.einsum("bshd,bchd->bhsc", q, k) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)
+    ).astype(q.dtype)
+    # slot valid if written and not in this query's future
+    valid = (cache_pos[None, :] >= 0) & (
+        cache_pos[None, :] <= q_pos[:, None]
+    )  # [S, C]
+    scores = jnp.where(
+        valid[None, None, :, :], scores, jnp.asarray(-1e30, scores.dtype)
+    )
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+        q.dtype
+    )
+    return jnp.einsum("bhsc,bchd->bshd", probs, v)
+
+
+def _decode_layers(config: LlamaConfig, params, x, positions, cache,
+                   write_idx):
+    """Run all layers for S tokens (S = prompt len at prefill, 1 at
+    decode), writing this step's K/V into the cache at ``write_idx``
+    ([S] slot indices). Returns (hidden, new_cache)."""
+    dtype = x.dtype
+    B, S, D = x.shape
+    h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
+
+    new_pos = cache.pos.at[write_idx].set(positions[0])
+
+    def layer(carry, xs):
+        hdn = carry
+        p, ck, cv = xs
+        y = _rms_norm(hdn, p["attn_norm"], config.norm_eps)
+        q = (y @ p["wq"].astype(dtype)).reshape(B, S, h, hd)
+        k = (y @ p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
+        v = (y @ p["wv"].astype(dtype)).reshape(B, S, kvh, hd)
+        q = _rope(q, positions, config.rope_theta)
+        k = _rope(k, positions, config.rope_theta)
+        ck = ck.at[:, write_idx].set(k)
+        cv = cv.at[:, write_idx].set(v)
+        attn = _cached_attention(
+            config, q, ck, cv, new_pos, positions[0]
+        ).reshape(B, S, h * hd)
+        hdn = hdn + attn @ p["wo"].astype(dtype)
+        y = _rms_norm(hdn, p["mlp_norm"], config.norm_eps)
+        gate = jax.nn.silu(y @ p["w_gate"].astype(dtype))
+        up = y @ p["w_up"].astype(dtype)
+        hdn = hdn + (gate * up) @ p["w_down"].astype(dtype)
+        return hdn, (ck, cv)
+
+    hidden, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache.k, cache.v)
+    )
+    return hidden, KVCache(k=new_k, v=new_v, pos=new_pos)
+
+
+def _logits(config: LlamaConfig, params, hidden):
+    x = _rms_norm(hidden, params["final_norm"], config.norm_eps)
+    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def prefill(config: LlamaConfig, params, tokens, cache: KVCache):
+    """Write the prompt's K/V; returns (last-token logits, cache)."""
+    dtype = jnp.dtype(config.dtype)
+    B, P = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    x = params["embed"].astype(dtype)[tokens]
+    C = cache.pos.shape[0]
+    write_idx = jnp.arange(P, dtype=jnp.int32) % C
+    hidden, cache = _decode_layers(
+        config, params, x, positions, cache, write_idx
+    )
+    return _logits(config, params, hidden[:, -1:, :])[:, 0], cache
+
+
+def decode_step(config: LlamaConfig, params, token, pos, cache: KVCache):
+    """One token for the whole batch. token [B], pos scalar absolute
+    position. Returns (logits [B, V], new_cache)."""
+    dtype = jnp.dtype(config.dtype)
+    B = token.shape[0]
+    positions = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32)[None, None], (B, 1)
+    )
+    x = params["embed"].astype(dtype)[token[:, None]]
+    C = cache.pos.shape[0]
+    write_idx = (jnp.asarray(pos, jnp.int32) % C)[None]
+    hidden, cache = _decode_layers(
+        config, params, x, positions, cache, write_idx
+    )
+    return _logits(config, params, hidden)[:, 0], cache
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerateConfig:
+    max_new_tokens: int = 64
+    temperature: float = 1.0
+    cache_capacity: int = 0  # 0 = prompt + max_new_tokens
+    eos_id: int = -1         # -1 = never stop early
+
+
+class GenerateResult(NamedTuple):
+    sequences: jnp.ndarray   # [B, P + N] prompt + continuation
+    logprobs: jnp.ndarray    # [B, N] sampled-token logprobs
+    mask: jnp.ndarray        # [B, N] 1.0 until (incl.) eos
+
+
+def generate(
+    config: LlamaConfig,
+    params,
+    prompt_tokens,
+    rng,
+    gen: GenerateConfig = GenerateConfig(),
+) -> GenerateResult:
+    """Jitted autoregressive sampling with the ring-buffer KV cache.
+
+    O(T) per new token (vs O(T^2) for re-running the full forward each
+    step — the reference's non-backend path this replaces)."""
+    B, P = prompt_tokens.shape
+    N = int(gen.max_new_tokens)
+    C = gen.cache_capacity or (P + N)
+    cache = init_kv_cache(config, B, C)
+    logits, cache = prefill(config, params, prompt_tokens, cache)
+
+    def sample(logits, rng):
+        if gen.temperature <= 0:
+            tok = jnp.argmax(logits, -1)
+        else:
+            tok = jax.random.categorical(
+                rng, logits / gen.temperature
+            )
+        logp = jax.nn.log_softmax(logits, -1)
+        return tok, jnp.take_along_axis(
+            logp, tok[:, None], axis=-1
+        )[:, 0]
+
+    tok0, lp0 = sample(logits, rng)
+    alive0 = jnp.ones((B,), jnp.float32)
+
+    def step(carry, i):
+        tok, cache, rng, alive = carry
+        rng, sub = jax.random.split(rng)
+        logits, cache = decode_step(config, params, tok, P + i, cache)
+        nxt, lp = sample(logits, sub)
+        # emit the newly-sampled token; it is masked out once an eos
+        # has been generated at or before the consumed token
+        alive = alive * (tok != gen.eos_id).astype(jnp.float32)
+        return (nxt, cache, rng, alive), (nxt, lp, alive)
+
+    if N > 1:
+        # the token sampled from prefill sits at absolute position P;
+        # scan step i consumes the token at position P + i
+        (_, _, _, _), (toks, lps, masks) = jax.lax.scan(
+            step, (tok0, cache, rng, alive0), jnp.arange(N - 1)
+        )
+        tokens = jnp.concatenate(
+            [tok0[None], toks], 0
+        ).T  # [B, N]
+        logprobs = jnp.concatenate([lp0[None], lps], 0).T
+        mask = jnp.concatenate([alive0[None], masks], 0).T
+    else:
+        tokens, logprobs, mask = tok0[:, None], lp0[:, None], \
+            alive0[:, None]
+    sequences = jnp.concatenate([prompt_tokens, tokens], axis=1)
+    return GenerateResult(sequences=sequences, logprobs=logprobs,
+                          mask=mask)
+
+
+class KVCacheGenerationBackend:
+    """The reference inference-backend role (vllm_backend.py): hands the
+    PPO loop fast rollouts. Jitted per (batch, prompt-len) shape."""
+
+    def __init__(self, config: LlamaConfig,
+                 gen: Optional[GenerateConfig] = None):
+        if config.is_moe:
+            raise NotImplementedError(
+                "KV-cache decoding implements the dense MLP only; "
+                "MoE decode (expert dispatch per token) is not wired yet"
+            )
+        self.config = config
+        self.gen = gen or GenerateConfig()
+        self._fn = jax.jit(
+            partial(generate, config, gen=self.gen)
+        )
+
+    def generate(self, params, prompt_tokens, rng) -> GenerateResult:
+        return self._fn(params, jnp.asarray(prompt_tokens), rng)

@@ -216,3 +216,67 @@ class PPOTrainer(RLTrainer):
                     batch,
                 )
         return stats
+
+
+class LMPPOTrainer(PPOTrainer):
+    """PPO for language-model RLHF: experience comes from the KV-cache
+    generation backend (reference vllm_backend.py role) instead of a
+    single full forward over pre-built obs.
+
+    Contracts: actor/ref apply(params, tokens [B,T]) -> logits
+    [B,T,V] (llama_loss-style decoders); critic apply -> values [B,T];
+    ``score_fn(sequences [B, P+N], gen_mask [B, N]) -> scores [B]``
+    judges the full generated text (sequence-level reward, spread to
+    the last generated position by rewards_with_kl's score placement).
+    """
+
+    def __init__(self, engine: ModelEngine, config: PPOConfig,
+                 llama_config, score_fn, gen=None, rng_seed: int = 0):
+        from dlrover_tpu.rl.generation import KVCacheGenerationBackend
+
+        super().__init__(engine, config, score_fn=score_fn,
+                         rng_seed=rng_seed)
+        self.backend = KVCacheGenerationBackend(llama_config, gen)
+
+    def make_experience(self, prompts):
+        """prompts: {"tokens": [B, P] int32}. Rolls out continuations
+        with the incremental decoder, then scores the sequences with
+        ONE teacher-forced forward per model (the O(T^2)-per-token
+        full-forward sampling loop this replaces is gone)."""
+        tokens = jnp.asarray(prompts["tokens"])
+        B, P = tokens.shape
+        res = self.backend.generate(
+            self.engine.params["actor"], tokens, self._next_rng()
+        )
+        seq = res.sequences                      # [B, P+N]
+        obs, targets = seq[:, :-1], seq[:, 1:]   # next-token pairs
+        # mask: only generated positions train (obs index P-1 predicts
+        # the first generated token), and only while un-terminated
+        T = obs.shape[1]
+        mask = jnp.zeros((B, T), jnp.float32).at[:, P - 1:].set(res.mask)
+
+        logits = self.engine.apply("actor", obs)
+        logprobs = logprobs_from_logits(logits, targets)
+        ref_logits = self.engine.apply(
+            "ref", obs
+        ) if "ref" in self.engine.specs else logits
+        ref_logprobs = logprobs_from_logits(ref_logits, targets)
+        values = self.engine.apply("critic", obs)
+        scores = jnp.asarray(self._score_fn(seq, res.mask))
+        rewards = rewards_with_kl(
+            scores, logprobs, ref_logprobs, mask, self.config.kl_coef
+        )
+        advantages, returns = gae_advantages_and_returns(
+            values, rewards, mask, self.config.gamma, self.config.lam,
+            self.config.whiten_advantages,
+        )
+        self.buffer.add_samples({
+            "obs": np.asarray(obs),
+            "actions": np.asarray(targets),
+            "old_logprobs": np.asarray(logprobs),
+            "old_values": np.asarray(values),
+            "advantages": np.asarray(advantages),
+            "returns": np.asarray(returns),
+            "mask": np.asarray(mask),
+        })
+        return float(jnp.mean(scores))

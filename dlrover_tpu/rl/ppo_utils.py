@@ -37,13 +37,24 @@ def kl_penalty(logprobs, ref_logprobs, kl_coef: float):
 def rewards_with_kl(scores, logprobs, ref_logprobs, mask,
                     kl_coef: float = 0.1):
     """Dense per-token reward = KL penalty everywhere + the scalar score
-    on the last valid token (reference get_rewards :55)."""
+    on the last valid token (reference get_rewards :55).
+
+    The last valid token is located positionally (last nonzero of the
+    mask), not as ``sum(mask)-1`` — LM-style masks are zero over the
+    prompt prefix, where the count-based index would land the score on
+    a masked position and GAE would silently drop the reward."""
     rewards = kl_penalty(logprobs, ref_logprobs, kl_coef) * mask
-    last = (
-        jnp.maximum(jnp.sum(mask, axis=-1) - 1, 0).astype(jnp.int32)
-    )
+    T = mask.shape[-1]
+    any_valid = jnp.sum(mask, axis=-1) > 0
+    last = jnp.where(
+        any_valid,
+        T - 1 - jnp.argmax(mask[:, ::-1] > 0, axis=-1),
+        0,
+    ).astype(jnp.int32)
     batch_idx = jnp.arange(rewards.shape[0])
-    rewards = rewards.at[batch_idx, last].add(scores)
+    rewards = rewards.at[batch_idx, last].add(
+        scores * any_valid.astype(rewards.dtype)
+    )
     return rewards
 
 

@@ -226,6 +226,26 @@ class TestBayesianSearch:
         bo.observe(1, 99.0)            # slow but real
         assert bo.best() == 1
 
+    def test_concurrent_get_task_before_any_report(self):
+        """3+ workers pull tasks before any result lands: suggest must
+        hand out distinct candidates, not crash on the empty GP."""
+        engine = StrategySearchEngine(
+            64, small_analysis(n_layers=32), devices_per_host=8,
+            hbm_gb=1024.0, max_dryruns=6, search_algo="bo",
+            max_candidates=16,
+        )
+        ids = [engine.get_task().task_id for _ in range(4)]
+        assert len(set(ids)) == 4
+
+    def test_failure_penalty_does_not_compound(self):
+        cands = self._candidates()
+        bo = BayesianSearch(cands)
+        bo.observe(0, 0.1)
+        for i in range(1, 5):
+            bo.observe(i, 0.0, ok=False)
+        penalties = [bo._observed[i] for i in range(1, 5)]
+        assert max(penalties) <= 1.0 + 1e-9  # max(0.1*10, 1.0), flat
+
     def test_engine_bo_mode(self):
         cands_n = len(self._candidates())
 
