@@ -173,6 +173,7 @@ class TimerRingExporter:
         )
         self._timer = None
         self._totals: dict = {}
+        self._export_lock = threading.Lock()
 
     def start(self):
         threading.Thread(
@@ -190,7 +191,13 @@ class TimerRingExporter:
         return self._timer
 
     def export_once(self) -> dict:
-        """Drain + aggregate; returns {tag_name: {count, avg_ms, max_ms}}."""
+        """Drain + aggregate; returns {tag_name: {count, avg_ms, max_ms}}.
+        Thread-safe: the /metrics endpoint and the export loop may both
+        call this."""
+        with self._export_lock:
+            return self._export_once_locked()
+
+    def _export_once_locked(self) -> dict:
         from dlrover_tpu.trainer.timer import Tag
 
         try:
@@ -227,6 +234,122 @@ class TimerRingExporter:
             except Exception:  # noqa: BLE001
                 pass
             self._stopped.wait(self._interval)
+
+
+class MetricsEndpoint:
+    """HTTP ``/metrics`` in Prometheus text exposition format.
+
+    Equivalent capability: reference xpu_timer's brpc/Prometheus export
+    (atorch/dev/xpu_timer/xpu_timer/common/manager.cc) — something a
+    cluster monitoring stack can actually scrape, instead of (only) the
+    JSON file the TimerRingExporter writes. Serves the timer aggregates
+    plus the worker's latest global step and host resource gauges."""
+
+    def __init__(self, exporter: TimerRingExporter | None = None,
+                 host: str = "0.0.0.0", port: int = 0):
+        self._exporter = exporter
+        self._host = host
+        self._port = port
+        self._server = None
+        self.port = 0  # actual bound port after start()
+
+    # ------------------------------------------------------------ render
+
+    def render(self) -> str:
+        lines = []
+
+        def metric(name, help_, mtype, samples):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for labels, value in samples:
+                label_s = (
+                    "{" + ",".join(
+                        f'{k}="{v}"' for k, v in labels.items()
+                    ) + "}" if labels else ""
+                )
+                lines.append(f"{name}{label_s} {value}")
+
+        stats = self._exporter.export_once() if self._exporter else {}
+        if stats:
+            metric(
+                "dlrtpu_timer_events_total",
+                "Timed events per tag (from the shm timing ring)",
+                "counter",
+                [({"tag": t}, a["count"]) for t, a in stats.items()],
+            )
+            metric(
+                "dlrtpu_timer_avg_ms",
+                "Average duration per tag in milliseconds",
+                "gauge",
+                [({"tag": t}, a["avg_ms"]) for t, a in stats.items()],
+            )
+            metric(
+                "dlrtpu_timer_max_ms",
+                "Max duration per tag in milliseconds",
+                "gauge",
+                [({"tag": t}, a["max_ms"]) for t, a in stats.items()],
+            )
+        path = os.environ.get(
+            ConfigPath.ENV_RUNTIME_METRICS, ConfigPath.RUNTIME_METRICS
+        )
+        try:
+            with open(path) as f:
+                rt = json.load(f)
+            metric(
+                "dlrtpu_global_step", "Latest reported training step",
+                "gauge", [({}, int(rt.get("step", 0)))],
+            )
+        except Exception:  # noqa: BLE001 - no worker progress yet
+            pass
+        metric(
+            "dlrtpu_host_memory_used_mb", "Host memory in use",
+            "gauge", [({}, get_used_memory_mb())],
+        )
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------- serve
+
+    def start(self) -> int:
+        import http.server
+
+        endpoint = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = endpoint.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(
+            (self._host, self._port), Handler
+        )
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, name="metrics-http",
+            daemon=True,
+        ).start()
+        logger.info("/metrics endpoint on port %d", self.port)
+        return self.port
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
 
 
 def write_runtime_metrics(step: int, **extra):

@@ -92,6 +92,9 @@ class ElasticLaunchConfig:
     # mesh, and the recompile must be a cache hit or it eats the goodput
     # the flash checkpoint bought (SURVEY hard-parts list). "" disables.
     compilation_cache_dir: str = "/tmp/dlrover_tpu/compile_cache"
+    # Prometheus /metrics endpoint on the agent (reference xpu_timer
+    # brpc/Prometheus export): 0 = ephemeral port, -1 = disabled
+    metrics_port: int = 0
 
     def auto_configure_params(self):
         """--auto-config: infer process count from visible devices."""
@@ -400,6 +403,19 @@ class ElasticTrainingAgent:
         self._heartbeat.start()
         self._resource_monitor.start()
         self._timer_exporter.start()
+        if self._config.metrics_port >= 0:
+            from dlrover_tpu.agent.monitor import MetricsEndpoint
+
+            self._metrics_endpoint = MetricsEndpoint(
+                self._timer_exporter, port=self._config.metrics_port
+            )
+            try:
+                self._metrics_endpoint.start()
+            except OSError as e:  # port in use: log, don't kill the job
+                logger.warning("metrics endpoint failed to bind: %s", e)
+                self._metrics_endpoint = None
+        else:
+            self._metrics_endpoint = None
         if self._paral_tuner is not None:
             self._paral_tuner.start()
         try:
@@ -410,6 +426,8 @@ class ElasticTrainingAgent:
             self._heartbeat.stop()
             self._resource_monitor.stop()
             self._timer_exporter.stop()
+            if self._metrics_endpoint is not None:
+                self._metrics_endpoint.stop()
             if self._paral_tuner is not None:
                 self._paral_tuner.stop()
 

@@ -131,3 +131,124 @@ def optimize_worker_count(store: MetricsStore, req: OptimizeRequest,
     if not efficient:
         return None
     return {"worker_count": max(efficient)}
+
+
+@register("hot_ps")
+def optimize_hot_ps(store: MetricsStore, req: OptimizeRequest):
+    """Detect hot nodes and plan per-node resource adjustments.
+
+    Reference optimize_job_hot_ps_resource.go: PS pods whose CPU
+    utilisation or memory crosses the hot thresholds get their CPU
+    extrapolated to the target worker count and memory bumped by a fixed
+    adjustment. TPU analogue: "nodes" are sparse/data hosts; records
+    carry per-node stats under ``nodes: [{node_id, cpu_percent,
+    used_memory_mb}]``."""
+    records = store.job_records(req.job_uuid, limit=20)
+    nodes = None
+    for r in records:
+        if r.get("nodes"):
+            nodes = r["nodes"]
+            break
+    if not nodes:
+        return None
+    cpu_hot = float(req.config.get("hot_cpu_threshold", 90.0))
+    mem_hot = float(req.config.get("hot_memory_threshold_mb", 0))
+    target_workers = int(req.config.get("target_worker_count", 0))
+    mem_adjust = int(req.config.get("memory_adjust_mb", 4096))
+    current_workers = int(
+        req.config.get("worker_count")
+        or next(
+            (r["worker_count"] for r in records
+             if r.get("worker_count")), 0,
+        )
+        or len(nodes)
+    )
+    adjustments = {}
+    for node in nodes:
+        node_id = node.get("node_id")
+        cpu = float(node.get("cpu_percent", 0.0))
+        mem = float(node.get("used_memory_mb", 0.0))
+        plan = {}
+        if cpu >= cpu_hot and current_workers > 0:
+            scale = (
+                target_workers / current_workers
+                if target_workers > 0 else 1.5
+            )
+            plan["cpu_percent_target"] = min(cpu * scale, 100.0 * 32)
+        if mem_hot and mem >= mem_hot:
+            plan["memory_mb"] = int(mem + mem_adjust)
+        if plan:
+            adjustments[str(node_id)] = plan
+    if not adjustments:
+        return None
+    return {"node_adjustments": adjustments}
+
+
+@register("init_adjust")
+def optimize_init_adjust(store: MetricsStore, req: OptimizeRequest):
+    """Early-phase right-sizing, before steady-state stats exist.
+
+    Reference optimize_job_ps_init_adjust_resource.go: while the step
+    count is under a threshold, extrapolate the observed per-node usage
+    to the target worker count plus a margin — catch under-provisioning
+    in the first minutes instead of after an OOM."""
+    records = store.job_records(req.job_uuid, limit=50)
+    if not records:
+        return None
+    step_threshold = int(req.config.get("step_count_threshold", 100))
+    latest_step = next(
+        (int(r["global_step"]) for r in records
+         if r.get("global_step") is not None),
+        0,
+    )
+    if latest_step >= step_threshold:
+        return None  # past the init window; worker_resource takes over
+    mems = [
+        float(r["used_memory_mb"]) for r in records
+        if r.get("used_memory_mb")
+    ]
+    if not mems:
+        return None
+    target_workers = int(req.config.get("target_worker_count", 0))
+    current_workers = int(
+        req.config.get("worker_count")
+        or next(
+            (r["worker_count"] for r in records
+             if r.get("worker_count")), 1,
+        )
+    )
+    headroom = float(req.config.get("init_headroom", 1.6))
+    scale = (
+        max(target_workers / max(current_workers, 1), 1.0)
+        if target_workers else 1.0
+    )
+    return {"memory_mb": int(max(mems) * scale * headroom)}
+
+
+@register("job_completion")
+def optimize_job_completion(store: MetricsStore, req: OptimizeRequest):
+    """Estimate time-to-completion from recent throughput.
+
+    The scheduler-facing half of the reference brain's job-runtime
+    estimation: fit steps/second over the newest records and project
+    the remaining steps; jobs without a known max_steps report their
+    throughput only."""
+    records = store.job_records(req.job_uuid, limit=100)
+    stepped = [
+        (float(r["timestamp"]), int(r["global_step"]))
+        for r in records if r.get("global_step") is not None
+    ]
+    if len(stepped) < 2:
+        return None
+    stepped.sort()
+    (t0, s0), (t1, s1) = stepped[0], stepped[-1]
+    if t1 <= t0 or s1 <= s0:
+        return None
+    speed = (s1 - s0) / (t1 - t0)
+    plan = {"steps_per_second": round(speed, 4)}
+    max_steps = int(req.config.get("max_steps", 0))
+    if max_steps > s1:
+        remaining = (max_steps - s1) / speed
+        plan["estimated_remaining_s"] = int(remaining)
+        plan["estimated_completion_ts"] = int(t1 + remaining)
+    return plan

@@ -5,6 +5,7 @@ from dlrover_tpu.optimizers.wsam import (
     wsam_update,
 )
 from dlrover_tpu.optimizers.low_bit import adam8bit, scale_by_adam8bit
+from dlrover_tpu.optimizers.offload import OffloadAdam, OffloadAdamState
 from dlrover_tpu.optimizers.group_sparse import group_adagrad, group_adam
 from dlrover_tpu.optimizers.mup import (
     mup_adam,
@@ -21,6 +22,8 @@ __all__ = [
     "wsam_update",
     "adam8bit",
     "scale_by_adam8bit",
+    "OffloadAdam",
+    "OffloadAdamState",
     "group_adam",
     "group_adagrad",
     "mup_adam",

@@ -1,0 +1,128 @@
+"""Host-offload Adam: optimizer state lives in host memory, not HBM.
+
+Equivalent capability: reference atorch/atorch/optimizers/adam_offload.py
+(PartitionAdam — CPU-resident optimizer state updated with GPU grads).
+TPU redesign: HBM holds only params (+ transient grads); the Adam
+moments stay in pinned host numpy buffers. Each step streams the grads
+device->host (``jax.device_get``), runs the vectorized Adam math on the
+host, and streams the *updates* host->device (``jax.device_put`` onto
+the params' own shardings). That trades HBM for PCIe/ICI-DCN traffic —
+the same trade the reference makes — and frees 2x fp32 param bytes of
+device memory, which is what lets a model one size up fit.
+
+Not an optax transformation on purpose: an optax ``update`` runs inside
+jit, where host state can't live. The step structure is
+grads-on-device -> host update -> apply-on-device, all overlap-friendly
+(device_get of leaf i overlaps the host math of leaf i-1).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class OffloadAdamState(NamedTuple):
+    count: int
+    mu: list          # host f32 buffers, one per leaf
+    nu: list
+
+
+class OffloadAdam:
+    """AdamW with host-resident moments.
+
+    Usage::
+
+        opt = OffloadAdam(1e-3, weight_decay=0.01)
+        state = opt.init(params)                  # host buffers
+        grads = jitted_grad_fn(params, batch)     # device
+        params, state = opt.step(params, grads, state)
+    """
+
+    def __init__(self, learning_rate: float = 1e-3, b1: float = 0.9,
+                 b2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0):
+        self.lr = learning_rate
+        self.b1 = b1
+        self.b2 = b2
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def init(self, params) -> OffloadAdamState:
+        import jax
+
+        leaves = jax.tree.leaves(params)
+        mu = [np.zeros(np.shape(p), np.float32) for p in leaves]
+        nu = [np.zeros(np.shape(p), np.float32) for p in leaves]
+        host_bytes = sum(b.nbytes for b in mu) * 2
+        logger.info(
+            "OffloadAdam: %.2f GB optimizer state on host",
+            host_bytes / (1 << 30),
+        )
+        return OffloadAdamState(count=0, mu=mu, nu=nu)
+
+    def step(self, params, grads, state: OffloadAdamState):
+        """Apply one AdamW step. Returns (new_params, new_state); the
+        updates are computed on host and placed back onto each param's
+        own sharding.
+
+        The moment buffers are updated IN PLACE (no per-step host
+        reallocation of 2x param bytes): the returned state aliases the
+        input state's buffers, so a previously-held ``OffloadAdamState``
+        is not a snapshot — use :meth:`state_dict` (which copies) to
+        checkpoint."""
+        import jax
+
+        leaves, treedef = jax.tree.flatten(params)
+        grad_leaves = jax.tree.leaves(grads)
+        t = state.count + 1
+        bc1 = 1.0 - self.b1**t
+        bc2 = 1.0 - self.b2**t
+        new_leaves = []
+        for i, (p, g) in enumerate(zip(leaves, grad_leaves)):
+            gh = np.asarray(jax.device_get(g), np.float32)
+            mu = state.mu[i]
+            nu = state.nu[i]
+            mu *= self.b1
+            mu += (1.0 - self.b1) * gh
+            nu *= self.b2
+            nu += (1.0 - self.b2) * np.square(gh)
+            update = (mu / bc1) / (np.sqrt(nu / bc2) + self.eps)
+            update = (-self.lr * update).astype(np.dtype(p.dtype))
+            sharding = getattr(p, "sharding", None)
+            upd_dev = (
+                jax.device_put(update, sharding)
+                if sharding is not None else jax.numpy.asarray(update)
+            )
+            # decoupled decay applied on device: no extra D2H of params
+            if self.weight_decay:
+                new_leaves.append(
+                    p * (1.0 - self.lr * self.weight_decay) + upd_dev
+                )
+            else:
+                new_leaves.append(p + upd_dev)
+        new_params = jax.tree.unflatten(treedef, new_leaves)
+        return new_params, OffloadAdamState(
+            count=t, mu=state.mu, nu=state.nu
+        )
+
+    # ------------------------------------------------------- checkpoints
+
+    def state_dict(self, state: OffloadAdamState) -> dict:
+        return {
+            "count": state.count,
+            "mu": [b.copy() for b in state.mu],
+            "nu": [b.copy() for b in state.nu],
+        }
+
+    def load_state_dict(self, d: dict) -> OffloadAdamState:
+        return OffloadAdamState(
+            count=int(d["count"]),
+            mu=[np.asarray(b, np.float32) for b in d["mu"]],
+            nu=[np.asarray(b, np.float32) for b in d["nu"]],
+        )

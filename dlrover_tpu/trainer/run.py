@@ -63,6 +63,11 @@ def parse_args(argv=None):
     )
     parser.add_argument("--log-dir", type=str, default=None)
     parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="Prometheus /metrics port on the agent "
+             "(0 = ephemeral, -1 = disabled)",
+    )
+    parser.add_argument(
         "--compilation-cache-dir",
         type=str,
         default=os.environ.get(
@@ -159,6 +164,7 @@ def run(args) -> int:
         rdzv_elastic_wait=args.rdzv_elastic_wait,
         log_dir=args.log_dir,
         compilation_cache_dir=args.compilation_cache_dir,
+        metrics_port=args.metrics_port,
     )
     script_args = list(args.training_script_args)
     if script_args and script_args[0] == "--":

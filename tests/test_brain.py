@@ -107,6 +107,69 @@ class TestAlgorithms:
         client, _ = brain
         assert client.optimize("j", "j", "nope") is None
 
+    def test_hot_ps_flags_hot_nodes(self, brain):
+        client, service = brain
+        service.store.persist("hot1", "jh", {
+            "worker_count": 4,
+            "nodes": [
+                {"node_id": 0, "cpu_percent": 95.0,
+                 "used_memory_mb": 9000},
+                {"node_id": 1, "cpu_percent": 20.0,
+                 "used_memory_mb": 3000},
+            ],
+        })
+        plan = client.optimize("hot1", "jh", "hot_ps", {
+            "hot_cpu_threshold": 90.0,
+            "hot_memory_threshold_mb": 8000,
+            "target_worker_count": 8,
+            "memory_adjust_mb": 2048,
+        })
+        adj = plan["node_adjustments"]
+        assert set(adj) == {"0"}
+        assert adj["0"]["memory_mb"] == 9000 + 2048
+        assert adj["0"]["cpu_percent_target"] == pytest.approx(190.0)
+
+    def test_hot_ps_no_hot_nodes(self, brain):
+        client, service = brain
+        service.store.persist("cool1", "jc", {
+            "nodes": [{"node_id": 0, "cpu_percent": 10.0,
+                       "used_memory_mb": 100}],
+        })
+        assert client.optimize("cool1", "jc", "hot_ps") is None
+
+    def test_init_adjust_early_phase_only(self, brain):
+        client, service = brain
+        service.store.persist("init1", "ji", {
+            "global_step": 10, "worker_count": 2,
+            "used_memory_mb": 1000,
+        })
+        plan = client.optimize("init1", "ji", "init_adjust", {
+            "step_count_threshold": 100, "target_worker_count": 4,
+            "init_headroom": 1.5,
+        })
+        # 1000 * (4/2) * 1.5
+        assert plan["memory_mb"] == 3000
+
+        # past the init window: defers to worker_resource
+        service.store.persist("init2", "ji", {
+            "global_step": 5000, "used_memory_mb": 1000,
+        })
+        assert client.optimize("init2", "ji", "init_adjust", {
+            "step_count_threshold": 100,
+        }) is None
+
+    def test_job_completion_estimate(self, brain):
+        client, service = brain
+        service.store.persist("jc1", "jj", {"global_step": 100},
+                              timestamp=1000.0)
+        service.store.persist("jc1", "jj", {"global_step": 600},
+                              timestamp=1100.0)
+        plan = client.optimize("jc1", "jj", "job_completion",
+                               {"max_steps": 1100})
+        assert plan["steps_per_second"] == pytest.approx(5.0)
+        assert plan["estimated_remaining_s"] == 100
+        assert plan["estimated_completion_ts"] == 1200
+
 
 class TestServiceRoundtrip:
     def test_persist_and_get_metrics_over_rpc(self, brain):

@@ -109,7 +109,9 @@ class TestAGD:
         state = opt.init({"w": jnp.ones((8,))})
         assert state[0].max_nu == ()
 
-    def test_checkpoint_with_legacy_max_nu_still_restores(self, tmp_path):
+    def test_checkpoint_with_legacy_max_nu_still_restores(
+        self, tmp_path, isolated_ckpt_env
+    ):
         """Checkpoints written when non-amsgrad AGD carried a
         param-sized max_nu slot must keep restoring: leaf matching is
         by name, so the extra leaves are simply ignored."""
@@ -239,6 +241,53 @@ class TestWSAM:
         for _ in range(300):
             params, state, l = step(params, state, None, None)
         assert float(l) < 1e-3
+
+
+class TestOffloadAdam:
+    def test_matches_optax_adamw(self):
+        """Host-resident moments must reproduce optax.adamw exactly
+        (same defaults, fp32)."""
+        from dlrover_tpu.optimizers import OffloadAdam
+
+        loss, params = quadratic_problem()
+        lr, wd = 1e-2, 0.01
+        off = OffloadAdam(lr, weight_decay=wd)
+        off_state = off.init(params)
+        ref = optax.adamw(lr, weight_decay=wd)
+        ref_state = ref.init(params)
+        p_off = dict(params)
+        p_ref = dict(params)
+        vg = jax.jit(jax.value_and_grad(loss))
+        for _ in range(25):
+            _, g = vg(p_off)
+            p_off, off_state = off.step(p_off, g, off_state)
+            _, g = vg(p_ref)
+            updates, ref_state = ref.update(g, ref_state, p_ref)
+            p_ref = optax.apply_updates(p_ref, updates)
+        np.testing.assert_allclose(
+            np.asarray(p_off["w"]), np.asarray(p_ref["w"]), rtol=2e-5,
+            atol=1e-6,
+        )
+
+    def test_state_lives_on_host(self):
+        from dlrover_tpu.optimizers import OffloadAdam
+
+        params = {"w": jnp.ones((64, 64))}
+        state = OffloadAdam(1e-3).init(params)
+        assert isinstance(state.mu[0], np.ndarray)
+        assert isinstance(state.nu[0], np.ndarray)
+
+    def test_state_dict_roundtrip(self):
+        from dlrover_tpu.optimizers import OffloadAdam
+
+        loss, params = quadratic_problem()
+        opt = OffloadAdam(1e-2)
+        state = opt.init(params)
+        _, g = jax.value_and_grad(loss)(params)
+        params, state = opt.step(params, g, state)
+        restored = opt.load_state_dict(opt.state_dict(state))
+        assert restored.count == state.count
+        np.testing.assert_array_equal(restored.mu[0], state.mu[0])
 
 
 class TestAdam8bit:
