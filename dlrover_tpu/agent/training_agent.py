@@ -93,8 +93,9 @@ class ElasticLaunchConfig:
     # the flash checkpoint bought (SURVEY hard-parts list). "" disables.
     compilation_cache_dir: str = "/tmp/dlrover_tpu/compile_cache"
     # Prometheus /metrics endpoint on the agent (reference xpu_timer
-    # brpc/Prometheus export): 0 = ephemeral port, -1 = disabled
-    metrics_port: int = 0
+    # brpc/Prometheus export): -1 = disabled (default: an HTTP listener
+    # is opt-in), 0 = ephemeral port, >0 = fixed port
+    metrics_port: int = -1
 
     def auto_configure_params(self):
         """--auto-config: infer process count from visible devices."""

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
+from dlrover_tpu.ops.fp8 import qdot
 from dlrover_tpu.parallel.sharding import shard_logical
 
 
@@ -39,6 +40,15 @@ class GPT2Config:
     tie_lm_head: bool = True
     # 0 = auto (pipeline_apply picks 2*stages); same contract as llama
     pipe_microbatches: int = 0
+    # "gpipe" | "1f1b" (loss-in-pipeline; same contract as llama)
+    pipe_schedule: str = "gpipe"
+
+    def __post_init__(self):
+        if self.pipe_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipe_schedule must be 'gpipe' or '1f1b', got "
+                f"{self.pipe_schedule!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -139,7 +149,7 @@ def _block(config: GPT2Config, x, p):
     dtype = x.dtype
 
     y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], config.norm_eps)
-    qkv = y @ p["w_qkv"].astype(dtype) + p["b_qkv"].astype(dtype)
+    qkv = qdot(y, p["w_qkv"].astype(dtype)) + p["b_qkv"].astype(dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, h, hd)
     k = k.reshape(B, S, h, hd)
@@ -149,15 +159,17 @@ def _block(config: GPT2Config, x, p):
     from dlrover_tpu.models.llama import _attention
 
     attn = _attention(config, q, k, v).reshape(B, S, D)
-    x = x + attn @ p["w_proj"].astype(dtype) + p["b_proj"].astype(dtype)
+    x = x + qdot(attn, p["w_proj"].astype(dtype)) \
+        + p["b_proj"].astype(dtype)
     x = shard_logical(x, ("batch", "seq", "embed"))
 
     y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], config.norm_eps)
     hmid = jax.nn.gelu(
-        y @ p["w_fc"].astype(dtype) + p["b_fc"].astype(dtype)
+        qdot(y, p["w_fc"].astype(dtype)) + p["b_fc"].astype(dtype)
     )
     hmid = shard_logical(hmid, ("batch", "seq", "mlp"))
-    x = x + hmid @ p["w_out"].astype(dtype) + p["b_out"].astype(dtype)
+    x = x + qdot(hmid, p["w_out"].astype(dtype)) \
+        + p["b_out"].astype(dtype)
     return shard_logical(x, ("batch", "seq", "embed"))
 
 
@@ -211,9 +223,58 @@ def gpt2_apply(config: GPT2Config, params, tokens, positions=None):
     return logits.astype(jnp.float32)
 
 
+def _gpt2_1f1b_loss(config: GPT2Config, params, tokens):
+    """1F1B training loss: final LN + head + CE run as the pipeline's
+    last stage (same schedule/normalization contract as llama's)."""
+    from dlrover_tpu.parallel.pipeline import (
+        pipe_size,
+        pipeline_loss_1f1b,
+        stage_layer_scan,
+    )
+
+    dtype = jnp.dtype(config.dtype)
+    inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    B, S = inputs.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = params["embed"].astype(dtype)[inputs]
+    x = x + params["pos_embed"].astype(dtype)[positions]
+    x = shard_logical(x, ("batch", "seq", "embed"))
+
+    def layer_fn(h, lp, pos):
+        del pos
+        return _block(config, h, lp), jnp.zeros((), jnp.float32)
+
+    stage_fn = stage_layer_scan(layer_fn, remat=config.remat)
+
+    M = config.pipe_microbatches or 2 * pipe_size()
+    valid_total = jnp.maximum((labels != -100).sum(), 1)
+
+    def last_fn(lp, h, labels_mb):
+        h = _layer_norm(
+            h, lp["final_ln_scale"], lp["final_ln_bias"], config.norm_eps
+        )
+        head = lp["embed"].T if config.tie_lm_head else lp["lm_head"]
+        logits = (h @ head.astype(dtype)).astype(jnp.float32)
+        loss, _valid = softmax_cross_entropy(logits, labels_mb)
+        return loss.sum() * (M / valid_total)
+
+    last_keys = ["final_ln_scale", "final_ln_bias"]
+    last_keys.append("embed" if config.tie_lm_head else "lm_head")
+    last_params = {k: params[k] for k in last_keys}
+    return pipeline_loss_1f1b(
+        stage_fn, last_fn, params["layers"], last_params, x,
+        stage_extras=(positions,), last_extras=(labels,),
+        n_microbatches=config.pipe_microbatches,
+    )
+
+
 def gpt2_loss_fn(config: GPT2Config):
+    from dlrover_tpu.parallel.pipeline import pipe_size
+
     def loss_fn(params, batch, rng):
         tokens = batch["tokens"]
+        if config.pipe_schedule == "1f1b" and pipe_size() > 1:
+            return _gpt2_1f1b_loss(config, params, tokens)
         logits = gpt2_apply(config, params, tokens[:, :-1])
         labels = tokens[:, 1:]
         loss, valid = softmax_cross_entropy(logits, labels)

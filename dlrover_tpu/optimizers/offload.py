@@ -80,6 +80,12 @@ class OffloadAdam:
 
         leaves, treedef = jax.tree.flatten(params)
         grad_leaves = jax.tree.leaves(grads)
+        # launch every D2H transfer before touching any bytes, so the
+        # copy of leaf i+1 overlaps the host math of leaf i (same
+        # pattern as the checkpoint engine's _write_shm_locked)
+        for g in grad_leaves:
+            if isinstance(g, jax.Array):
+                g.copy_to_host_async()
         t = state.count + 1
         bc1 = 1.0 - self.b1**t
         bc2 = 1.0 - self.b2**t

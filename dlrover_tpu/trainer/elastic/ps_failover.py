@@ -91,6 +91,13 @@ class PsFailoverMonitor:
         while not self._stopped.is_set():
             try:
                 self._client.maybe_refresh(self._on_migrate)
-            except Exception:  # noqa: BLE001 - master briefly away
-                pass
+            except Exception as err:  # noqa: BLE001
+                # transient master-RPC failures are expected; a failing
+                # migration callback is not — either way the operator
+                # needs the trace, because an unsynced worker keeps the
+                # master's all_workers_synced() false forever
+                logger.warning(
+                    "PS failover refresh failed (will retry): %s", err,
+                    exc_info=True,
+                )
             self._stopped.wait(self._interval)

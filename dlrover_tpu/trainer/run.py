@@ -63,9 +63,9 @@ def parse_args(argv=None):
     )
     parser.add_argument("--log-dir", type=str, default=None)
     parser.add_argument(
-        "--metrics-port", type=int, default=0,
+        "--metrics-port", type=int, default=-1,
         help="Prometheus /metrics port on the agent "
-             "(0 = ephemeral, -1 = disabled)",
+             "(-1 = disabled [default], 0 = ephemeral, >0 = fixed)",
     )
     parser.add_argument(
         "--compilation-cache-dir",
