@@ -173,13 +173,13 @@ def _block(config: GPT2Config, x, p):
     return shard_logical(x, ("batch", "seq", "embed"))
 
 
-def gpt2_apply(config: GPT2Config, params, tokens, positions=None):
-    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+def _gpt2_embed(config: GPT2Config, params, tokens, positions=None):
+    """Token + learned position embeddings, with the trace-time
+    max_seq_len guard (JAX gather would silently clamp out-of-range
+    positions to the last learned row)."""
     dtype = jnp.dtype(config.dtype)
     B, S = tokens.shape
     if S > config.max_seq_len:
-        # JAX gather would silently clamp out-of-range positions to the
-        # last learned embedding row — reject at trace time instead
         raise ValueError(
             f"sequence length {S} exceeds max_seq_len "
             f"{config.max_seq_len}"
@@ -190,26 +190,35 @@ def gpt2_apply(config: GPT2Config, params, tokens, positions=None):
         )
     x = params["embed"].astype(dtype)[tokens]
     x = x + params["pos_embed"].astype(dtype)[positions]
-    x = shard_logical(x, ("batch", "seq", "embed"))
+    return shard_logical(x, ("batch", "seq", "embed"))
 
-    from dlrover_tpu.parallel.pipeline import (
-        pipe_size,
-        pipeline_apply,
-        stage_layer_scan,
-    )
 
-    def layer_fn(h, lp, pos):
-        del pos
+def _gpt2_stage_fn(config: GPT2Config):
+    """Per-stage layer scan (positions already folded into the input
+    embeddings, so layers take no extras)."""
+    from dlrover_tpu.parallel.pipeline import stage_layer_scan
+
+    def layer_fn(h, lp):
         return _block(config, h, lp), jnp.zeros((), jnp.float32)
 
-    stage_fn = stage_layer_scan(layer_fn, remat=config.remat)
+    return stage_layer_scan(layer_fn, remat=config.remat)
+
+
+def gpt2_apply(config: GPT2Config, params, tokens, positions=None):
+    """tokens [B, S] int32 -> logits [B, S, vocab] float32."""
+    dtype = jnp.dtype(config.dtype)
+    x = _gpt2_embed(config, params, tokens, positions)
+
+    from dlrover_tpu.parallel.pipeline import pipe_size, pipeline_apply
+
+    stage_fn = _gpt2_stage_fn(config)
     if pipe_size() > 1:
         x, _aux = pipeline_apply(
-            stage_fn, params["layers"], x, positions,
+            stage_fn, params["layers"], x,
             n_microbatches=config.pipe_microbatches,
         )
     else:
-        x, _aux = stage_fn(params["layers"], x, positions)
+        x, _aux = stage_fn(params["layers"], x)
 
     x = _layer_norm(
         x, params["final_ln_scale"], params["final_ln_bias"],
@@ -229,22 +238,12 @@ def _gpt2_1f1b_loss(config: GPT2Config, params, tokens):
     from dlrover_tpu.parallel.pipeline import (
         pipe_size,
         pipeline_loss_1f1b,
-        stage_layer_scan,
     )
 
     dtype = jnp.dtype(config.dtype)
     inputs, labels = tokens[:, :-1], tokens[:, 1:]
-    B, S = inputs.shape
-    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    x = params["embed"].astype(dtype)[inputs]
-    x = x + params["pos_embed"].astype(dtype)[positions]
-    x = shard_logical(x, ("batch", "seq", "embed"))
-
-    def layer_fn(h, lp, pos):
-        del pos
-        return _block(config, h, lp), jnp.zeros((), jnp.float32)
-
-    stage_fn = stage_layer_scan(layer_fn, remat=config.remat)
+    x = _gpt2_embed(config, params, inputs)
+    stage_fn = _gpt2_stage_fn(config)
 
     M = config.pipe_microbatches or 2 * pipe_size()
     valid_total = jnp.maximum((labels != -100).sum(), 1)
@@ -263,7 +262,7 @@ def _gpt2_1f1b_loss(config: GPT2Config, params, tokens):
     last_params = {k: params[k] for k in last_keys}
     return pipeline_loss_1f1b(
         stage_fn, last_fn, params["layers"], last_params, x,
-        stage_extras=(positions,), last_extras=(labels,),
+        last_extras=(labels,),
         n_microbatches=config.pipe_microbatches,
     )
 

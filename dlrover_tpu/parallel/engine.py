@@ -304,6 +304,63 @@ class DryRunner:
         return result
 
 
+def cost_model_rank_correlation(
+    candidates: list[Strategy], results: list["DryRunResult"],
+) -> float | None:
+    """Spearman rank correlation between the cost-model ordering (the
+    candidates list is emitted best-first) and measured step times.
+
+    The cost-model weights are tie-breaker heuristics; this validates
+    them against dry-run truth after every search — a correlation near
+    zero (or negative) means the analytic model is misleading the
+    search on this hardware/model and its ordering should not be
+    trusted beyond memory feasibility. Returns None with <3 usable
+    points."""
+    index_of = {id(s): i for i, s in enumerate(candidates)}
+    pairs = [
+        (index_of[id(r.strategy)], r.step_s)
+        for r in results
+        if r.ok and id(r.strategy) in index_of
+    ]
+    if len(pairs) < 3:
+        return None
+    ranks_model = _ranks([p[0] for p in pairs])
+    ranks_meas = _ranks([p[1] for p in pairs])
+    # Pearson on the (fractional) ranks — the tie-correct Spearman form;
+    # zero variance (e.g. all measurements tied) carries no ordering
+    # signal at all, so report None rather than a fake correlation
+    n = len(pairs)
+    m1 = sum(ranks_model) / n
+    m2 = sum(ranks_meas) / n
+    cov = sum(
+        (a - m1) * (b - m2) for a, b in zip(ranks_model, ranks_meas)
+    )
+    v1 = sum((a - m1) ** 2 for a in ranks_model)
+    v2 = sum((b - m2) ** 2 for b in ranks_meas)
+    if v1 <= 0 or v2 <= 0:
+        return None
+    return cov / (v1 * v2) ** 0.5
+
+
+def _ranks(values: list) -> list[float]:
+    """Fractional (average) ranks: ties share their mean rank, as
+    Spearman requires — otherwise equal measurements would inherit
+    list-order ranks and fake a perfect correlation."""
+    order = sorted(range(len(values)), key=lambda i: values[i])
+    ranks = [0.0] * len(values)
+    i = 0
+    while i < len(order):
+        j = i
+        while j + 1 < len(order) and \
+                values[order[j + 1]] == values[order[i]]:
+            j += 1
+        avg = (i + j) / 2.0
+        for k in range(i, j + 1):
+            ranks[order[k]] = avg
+        i = j + 1
+    return ranks
+
+
 # --------------------------------------------------------------------------
 # Bayesian-optimization search generator
 # (reference atorch/auto/engine/sg_algo/bayes_opt_sg.py with its vendored
@@ -549,6 +606,17 @@ class StrategySearchEngine:
             logger.warning("all dry-runs failed; using top candidate")
             return self._candidates[0]
         best = min(ok, key=lambda r: r.step_s)
+        corr = cost_model_rank_correlation(
+            self._candidates, self._results
+        )
+        if corr is not None:
+            logger.info(
+                "cost-model calibration: rank correlation with "
+                "measured step times = %.2f%s", corr,
+                "" if corr >= 0.3 else
+                " (weak: analytic ordering unreliable here beyond "
+                "memory feasibility)",
+            )
         logger.info(
             "strategy search: %s wins (%.4fs/step over %d candidates)",
             best.strategy.describe(), best.step_s, len(ok),

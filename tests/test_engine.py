@@ -6,6 +6,7 @@ atorch/tests auto_accelerate_test.py / engine tests.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 from dlrover_tpu.parallel.engine import (
@@ -266,6 +267,39 @@ class TestBayesianSearch:
         best = engine.search()
         assert isinstance(best, Strategy)
         assert runner.calls <= 5 < cands_n
+
+
+class TestCostModelCalibration:
+    def test_rank_correlation(self):
+        from dlrover_tpu.parallel.engine import (
+            cost_model_rank_correlation,
+        )
+
+        cands = candidate_strategies(
+            8, small_analysis(), hbm_gb=1024.0, max_candidates=8
+        )
+        # measured times agreeing with the cost order -> corr 1.0
+        agreeing = [
+            DryRunResult(s, step_s=0.1 + 0.01 * i)
+            for i, s in enumerate(cands[:5])
+        ]
+        assert cost_model_rank_correlation(cands, agreeing) == \
+            pytest.approx(1.0)
+        # reversed -> corr -1.0
+        opposing = [
+            DryRunResult(s, step_s=0.1 - 0.01 * i)
+            for i, s in enumerate(cands[:5])
+        ]
+        assert cost_model_rank_correlation(cands, opposing) == \
+            pytest.approx(-1.0)
+        # failures and tiny samples excluded
+        assert cost_model_rank_correlation(cands, agreeing[:2]) is None
+        failed = [DryRunResult(s, ok=False) for s in cands[:5]]
+        assert cost_model_rank_correlation(cands, failed) is None
+        # all-tied measurements carry no ordering signal: must report
+        # None, not a fake perfect calibration from list-order ranks
+        tied = [DryRunResult(s, step_s=0.1) for s in cands[:5]]
+        assert cost_model_rank_correlation(cands, tied) is None
 
 
 class TestEstimate:

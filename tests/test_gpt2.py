@@ -128,6 +128,23 @@ class TestGPT2:
                               jax.random.key(0))
         assert np.isfinite(float(m["loss"]))
 
+    def test_1f1b_rejects_overlong_sequences(self, tiny):
+        """The 1f1b path must keep gpt2_apply's trace-time guard: a
+        too-long batch raises instead of silently clamping positions."""
+        import dataclasses
+
+        from dlrover_tpu.models.gpt2 import _gpt2_1f1b_loss
+
+        cfg = dataclasses.replace(
+            tiny, pipe_microbatches=2, pipe_schedule="1f1b"
+        )
+        params = gpt2_init(cfg, jax.random.key(0))
+        too_long = jnp.zeros(
+            (4, cfg.max_seq_len + 2), jnp.int32
+        )
+        with pytest.raises(ValueError, match="max_seq_len"):
+            _gpt2_1f1b_loss(cfg, params, too_long)
+
     def test_1f1b_matches_gpipe_loss(self, tiny):
         import dataclasses
 
