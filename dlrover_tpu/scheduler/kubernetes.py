@@ -249,7 +249,28 @@ class PodWatcher:
 
 
 def new_pod_scaler_and_watcher(job_args):
-    client = K8sClient.singleton_instance(job_args.namespace)
+    """An explicit DLROVER_TPU_K8S_API endpoint always uses the stdlib
+    REST client (it must win even when the kubernetes package is
+    installed but has no kubeconfig); otherwise the official client,
+    with an in-cluster REST fallback when the package is absent — lean
+    TPU images ship without it."""
+    import os
+
+    from dlrover_tpu.scheduler.rest_client import RestK8sClient
+
+    if os.environ.get("DLROVER_TPU_K8S_API"):
+        logger.info("using the REST client (DLROVER_TPU_K8S_API set)")
+        client = RestK8sClient(namespace=job_args.namespace)
+    else:
+        try:
+            client = K8sClient.singleton_instance(job_args.namespace)
+        except RuntimeError:
+            if not os.environ.get("KUBERNETES_SERVICE_HOST"):
+                raise
+            logger.info(
+                "kubernetes package absent; using the REST client"
+            )
+            client = RestK8sClient(namespace=job_args.namespace)
     scaler = PodScaler(job_args.job_name, client)
     watcher = PodWatcher(job_args.job_name, client)
     return scaler, watcher

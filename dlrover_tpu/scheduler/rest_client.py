@@ -1,0 +1,189 @@
+"""Dependency-free Kubernetes core-API client (pods) over REST + JSON.
+
+Equivalent capability: the pod surface of the reference's k8sClient
+(dlrover/python/scheduler/kubernetes.py:121), which wraps the official
+``kubernetes`` package. That package is heavyweight and absent from
+lean TPU images; the API server itself speaks plain REST, so this
+client implements exactly the calls PodScaler/PodWatcher need with the
+standard library only — and makes the scheduler testable against a real
+(fake) HTTP API server instead of monkeypatched methods.
+
+Pods come back as :class:`ApiObject` wrappers giving the attribute
+access the rest of the scheduler uses (``pod.metadata.labels``,
+``pod.status.host_ip``), with snake_case -> camelCase JSON mapping.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.parse
+import urllib.request
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def _snake_to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(part.title() for part in rest)
+
+
+class ApiObject:
+    """Read-only attribute view over a JSON dict (nested)."""
+
+    def __init__(self, data: dict):
+        self._data = data or {}
+
+    def __getattr__(self, name: str):
+        data = object.__getattribute__(self, "_data")
+        for key in (name, _snake_to_camel(name)):
+            if key in data:
+                value = data[key]
+                return ApiObject(value) if isinstance(value, dict) \
+                    else value
+        # acronym-bearing keys ("hostIP") defeat naive camelCase;
+        # fall back to case/underscore-insensitive matching
+        want = name.replace("_", "").lower()
+        for key, value in data.items():
+            if key.replace("_", "").lower() == want:
+                return ApiObject(value) if isinstance(value, dict) \
+                    else value
+        return None
+
+    def get(self, key, default=None):
+        """dict-style access — pod labels are read with .get() by
+        pod_to_node, matching the official client's plain-dict labels."""
+        value = self._data.get(key, default)
+        return ApiObject(value) if isinstance(value, dict) else value
+
+    def to_dict(self) -> dict:
+        return self._data
+
+    def __repr__(self):
+        return f"ApiObject({self._data!r})"
+
+
+class RestK8sClient:
+    """The pod API surface of K8sClient, stdlib-only.
+
+    ``base_url`` resolution order: explicit argument, the
+    ``DLROVER_TPU_K8S_API`` env var, then the in-cluster service env
+    (``KUBERNETES_SERVICE_HOST``/``_PORT`` with the service-account
+    token and CA).
+    """
+
+    def __init__(self, base_url: str | None = None,
+                 namespace: str = "default",
+                 token: str | None = None,
+                 ca_cert: str | None = None):
+        if base_url is None:
+            base_url = os.environ.get("DLROVER_TPU_K8S_API", "")
+        self._token_file = None
+        if not base_url and os.environ.get("KUBERNETES_SERVICE_HOST"):
+            host = os.environ["KUBERNETES_SERVICE_HOST"]
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+            token_file = os.path.join(_SA_DIR, "token")
+            if token is None and os.path.exists(token_file):
+                # bound SA tokens expire and are refreshed on disk by
+                # the kubelet — remember the path, re-read per request
+                self._token_file = token_file
+            ca_file = os.path.join(_SA_DIR, "ca.crt")
+            if ca_cert is None and os.path.exists(ca_file):
+                ca_cert = ca_file
+        if not base_url:
+            raise RuntimeError(
+                "no k8s API endpoint: set DLROVER_TPU_K8S_API or run "
+                "in-cluster"
+            )
+        self.base_url = base_url.rstrip("/")
+        self.namespace = namespace
+        self._token = token
+        self._ssl_ctx = None
+        if self.base_url.startswith("https"):
+            self._ssl_ctx = ssl.create_default_context(cafile=ca_cert)
+
+    # ------------------------------------------------------------- http
+
+    def _request(self, method: str, path: str, body=None, query=None,
+                 timeout: float = 30.0):
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        token = self._token
+        if token is None and self._token_file:
+            with open(self._token_file) as f:
+                token = f.read().strip()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        return urllib.request.urlopen(
+            req, timeout=timeout, context=self._ssl_ctx
+        )
+
+    def _pods_path(self) -> str:
+        return f"/api/v1/namespaces/{self.namespace}/pods"
+
+    # -------------------------------------------------------- pod verbs
+
+    def create_pod(self, pod_spec: dict) -> bool:
+        with self._request("POST", self._pods_path(), body=pod_spec):
+            pass
+        return True
+
+    def delete_pod(self, name: str) -> bool:
+        with self._request(
+            "DELETE", f"{self._pods_path()}/{name}"
+        ):
+            pass
+        return True
+
+    def list_pods(self, label_selector: str):
+        with self._request(
+            "GET", self._pods_path(),
+            query={"labelSelector": label_selector},
+        ) as resp:
+            data = json.loads(resp.read().decode())
+        return ApiObject({
+            "items": [ApiObject(p) for p in data.get("items", [])]
+        })
+
+    def watch_pods(self, label_selector: str, timeout: int):
+        """Yield {"type": ..., "object": ApiObject} events (the k8s
+        watch protocol: one JSON document per line).
+
+        Connection failures PROPAGATE (like the official client's
+        watch): the master's monitor loop catches them and backs off —
+        a silently-empty generator would turn that loop into a hot spin
+        against a down API server."""
+        resp = self._request(
+            "GET", self._pods_path(),
+            query={
+                "labelSelector": label_selector,
+                "watch": "true",
+                "timeoutSeconds": str(int(timeout)),
+            },
+            timeout=timeout + 5,
+        )
+        with resp:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line.decode())
+                except ValueError:
+                    continue
+                yield {
+                    "type": event.get("type", "MODIFIED"),
+                    "object": ApiObject(event.get("object") or {}),
+                }
