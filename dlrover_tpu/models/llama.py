@@ -52,9 +52,13 @@ class LlamaConfig:
     # only; "dots" additionally saves batched dots
     remat_policy: str = "dots_attn"
     # measured on v5e (nano-350m, seq 2048): 1024x1024 beats 512x512 by
-    # ~15% tokens/s; 2048-wide K blocks fail to fit VMEM
+    # ~15% tokens/s; 2048-wide K blocks fail to fit VMEM. A bwd-block
+    # sweep (1024/512/256 combinations) found the fwd blocks also
+    # optimal for the bwd kernels at these shapes; 0 = use fwd blocks
     attn_block_q: int = 1024
     attn_block_k: int = 1024
+    attn_bwd_block_q: int = 0
+    attn_bwd_block_k: int = 0
     # pipeline microbatches when the ``pipe`` mesh axis is active
     # (0 = default 2 * n_stages)
     pipe_microbatches: int = 0
@@ -251,6 +255,8 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt):
         return flash_attention(
             q, k, v, causal=True,
             block_q=config.attn_block_q, block_k=config.attn_block_k,
+            bwd_block_q=config.attn_bwd_block_q,
+            bwd_block_k=config.attn_bwd_block_k,
         )
 
     try:

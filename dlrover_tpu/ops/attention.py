@@ -457,18 +457,24 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
 # happen at the primal level.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _anchor(q, k, v, o, lse, sm_scale, causal, block_q, block_k, interpret):
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11)
+)
+def _anchor(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
+            bwd_block_q, bwd_block_k, interpret):
     return o
 
 
 def _anchor_fwd(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
-                interpret):
+                bwd_block_q, bwd_block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _anchor_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k, interpret, res, do)
+def _anchor_bwd(sm_scale, causal, block_q, block_k, bwd_block_q,
+                bwd_block_k, interpret, res, do):
+    dq, dk, dv = _bwd(
+        sm_scale, causal, bwd_block_q, bwd_block_k, interpret, res, do
+    )
     _, _, _, o, lse = res
     return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
 
@@ -476,7 +482,8 @@ def _anchor_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
 _anchor.defvjp(_anchor_fwd, _anchor_bwd)
 
 
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, bwd_block_q,
+           bwd_block_k, interpret):
     from jax.ad_checkpoint import checkpoint_name
 
     # stop_gradient on the *inputs* keeps AD tracing out of the pallas
@@ -490,7 +497,7 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     o = checkpoint_name(o, "attn_out")
     lse = checkpoint_name(lse, "attn_out")
     return _anchor(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
-                   interpret)
+                   bwd_block_q, bwd_block_k, interpret)
 
 
 def flash_attention(
@@ -499,6 +506,8 @@ def flash_attention(
     sm_scale: float | None = None,
     block_q: int = 512,
     block_k: int = 512,
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Multi-head attention, O(S) memory, MXU-tiled.
@@ -506,6 +515,9 @@ def flash_attention(
     Args:
       q: [batch, heads, q_len, head_dim]
       k, v: [batch, kv_heads, kv_len, head_dim]; heads % kv_heads == 0.
+      bwd_block_q/k: backward-kernel tile sizes; default to the forward
+        blocks. The dq/dkv kernels hold more live buffers per tile than
+        the forward, so their VMEM-optimal blocks are often smaller.
     Returns [batch, heads, q_len, head_dim] in q.dtype.
     """
     if sm_scale is None:
@@ -515,7 +527,9 @@ def flash_attention(
     if interpret is None:
         interpret = _use_interpret()
     return _flash(q, k, v, float(sm_scale), bool(causal),
-                  int(block_q), int(block_k), bool(interpret))
+                  int(block_q), int(block_k),
+                  int(bwd_block_q or block_q), int(bwd_block_k or block_k),
+                  bool(interpret))
 
 
 def mha_reference(q, k, v, causal: bool = True, sm_scale: float | None = None):

@@ -88,14 +88,17 @@ class RestK8sClient:
             host = os.environ["KUBERNETES_SERVICE_HOST"]
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
             base_url = f"https://{host}:{port}"
-            token_file = os.path.join(_SA_DIR, "token")
-            if token is None and os.path.exists(token_file):
-                # bound SA tokens expire and are refreshed on disk by
-                # the kubelet — remember the path, re-read per request
-                self._token_file = token_file
-            ca_file = os.path.join(_SA_DIR, "ca.crt")
-            if ca_cert is None and os.path.exists(ca_file):
-                ca_cert = ca_file
+        # service-account credentials apply however the endpoint was
+        # resolved: an explicit DLROVER_TPU_K8S_API pointing at a real
+        # secured API server still needs the on-disk token and CA
+        token_file = os.path.join(_SA_DIR, "token")
+        if token is None and os.path.exists(token_file):
+            # bound SA tokens expire and are refreshed on disk by the
+            # kubelet — remember the path, re-read per request
+            self._token_file = token_file
+        ca_file = os.path.join(_SA_DIR, "ca.crt")
+        if ca_cert is None and os.path.exists(ca_file):
+            ca_cert = ca_file
         if not base_url:
             raise RuntimeError(
                 "no k8s API endpoint: set DLROVER_TPU_K8S_API or run "
