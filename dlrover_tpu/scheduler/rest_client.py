@@ -88,17 +88,6 @@ class RestK8sClient:
             host = os.environ["KUBERNETES_SERVICE_HOST"]
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
             base_url = f"https://{host}:{port}"
-        # service-account credentials apply however the endpoint was
-        # resolved: an explicit DLROVER_TPU_K8S_API pointing at a real
-        # secured API server still needs the on-disk token and CA
-        token_file = os.path.join(_SA_DIR, "token")
-        if token is None and os.path.exists(token_file):
-            # bound SA tokens expire and are refreshed on disk by the
-            # kubelet — remember the path, re-read per request
-            self._token_file = token_file
-        ca_file = os.path.join(_SA_DIR, "ca.crt")
-        if ca_cert is None and os.path.exists(ca_file):
-            ca_cert = ca_file
         if not base_url:
             raise RuntimeError(
                 "no k8s API endpoint: set DLROVER_TPU_K8S_API or run "
@@ -106,10 +95,27 @@ class RestK8sClient:
             )
         self.base_url = base_url.rstrip("/")
         self.namespace = namespace
+        # Service-account credentials apply to https endpoints however
+        # the endpoint was resolved (an explicit DLROVER_TPU_K8S_API at
+        # a real secured API server needs them too) — but never over
+        # plain http, which would leak the cluster credential.
+        if self.base_url.startswith("https"):
+            token_file = os.path.join(_SA_DIR, "token")
+            if token is None and os.path.exists(token_file):
+                # bound SA tokens rotate on disk (kubelet) — remember
+                # the path, re-read per request
+                self._token_file = token_file
         self._token = token
         self._ssl_ctx = None
         if self.base_url.startswith("https"):
+            # system trust store PLUS (not instead of) the cluster CA:
+            # an explicit endpoint may sit behind a publicly-signed
+            # proxy while in-cluster servers use the self-signed SA CA
             self._ssl_ctx = ssl.create_default_context(cafile=ca_cert)
+            if ca_cert is None:
+                ca_file = os.path.join(_SA_DIR, "ca.crt")
+                if os.path.exists(ca_file):
+                    self._ssl_ctx.load_verify_locations(cafile=ca_file)
 
     # ------------------------------------------------------------- http
 

@@ -142,20 +142,22 @@ class TestDelayedScaling:
 
 
 class TestEndToEndNumerics:
-    def _run(self, dtype):
-        config = LlamaConfig(
+    def _run(self, dtype, steps=12, mesh=None, lr=5e-3, **config_kw):
+        cfg = dict(
             vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
             mlp_dim=64, max_seq_len=32, attn_impl="reference",
             remat=False, dtype="float32",
         )
+        cfg.update(config_kw)
+        config = LlamaConfig(**cfg)
         strategy = Strategy(
-            mesh=MeshConfig(data=2, fsdp=4), compute_dtype=dtype,
-            remat="none",
+            mesh=mesh or MeshConfig(data=2, fsdp=4),
+            compute_dtype=dtype, remat="none",
         )
         res = auto_accelerate(
             loss_fn=llama_loss_fn(config),
             init_fn=lambda rng: llama_init(config, rng),
-            optimizer=optax.adamw(5e-3),
+            optimizer=optax.adamw(lr),
             param_logical_axes=llama_logical_axes(config),
             strategy=strategy,
         )
@@ -164,10 +166,21 @@ class TestEndToEndNumerics:
         }
         state = res.state
         losses = []
-        for i in range(12):
+        for i in range(steps):
             state, m = res.train_step(state, batch, jax.random.key(i))
             losses.append(float(m["loss"]))
         return losses
+
+    def test_fp8_composes_with_1f1b_pipeline(self):
+        """compute_dtype='fp8' and pipe_schedule='1f1b' together: the
+        autocast flag is up while the fused schedule traces, so the
+        stage matmuls quantize inside the pipeline's custom VJP."""
+        losses = self._run(
+            "fp8", steps=8, mesh=MeshConfig(pipe=2, fsdp=4),
+            n_layers=4, pipe_microbatches=4, pipe_schedule="1f1b",
+        )
+        assert np.isfinite(losses[-1])
+        assert losses[-1] < losses[0], losses
 
     def test_fp8_tracks_bf16(self):
         """Strategy.compute_dtype='fp8' must train: loss decreases and
