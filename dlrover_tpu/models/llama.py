@@ -26,7 +26,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from dlrover_tpu.ops.attention import flash_attention, mha_reference
+from dlrover_tpu.ops.attention import (
+    flash_attention,
+    flash_attention_bshd,
+    mha_reference,
+)
 from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
 from dlrover_tpu.ops.fp8 import qdot
 from dlrover_tpu.parallel.sharding import shard_logical
@@ -44,7 +48,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"          # activation/compute dtype
-    attn_impl: str = "flash"         # "flash" | "reference"
+    # "flash" (Pallas, [B,H,S,Dh]) | "bshd" (Pallas, model-native
+    # zero-transpose layout) | "ulysses" | "reference"
+    attn_impl: str = "flash"
     remat: bool = True               # checkpoint each scanned layer
     # checkpoint policy when remat=True: "dots_attn" saves weight
     # matmuls AND the flash-attention output (the Pallas kernel is the
@@ -229,30 +235,49 @@ def _rms_norm(x, scale, eps):
     return normed * scale.astype(x.dtype)
 
 
-def _rope(x, positions, theta):
-    """x: [B, S, H, Dh]; rotate pairs (first half, second half)."""
-    half = x.shape[-1] // 2
+def _rope_tables(positions, half, theta, dtype):
+    """cos/sin tables [B, S, half] — computed ONCE per step and passed
+    into the layer scan (the trig is identical for every layer; leaving
+    it inside the scanned body recomputes it depth times)."""
     freqs = jnp.exp(
         -jnp.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
     )
     angles = positions[:, :, None].astype(jnp.float32) * freqs  # [B,S,half]
-    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def _rope_apply(x, cos, sin):
+    """x: [B, S, H, Dh]; rotate pairs (first half, second half)."""
+    half = x.shape[-1] // 2
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
-    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], -1)
 
 
-def _sharded_flash(config: LlamaConfig, qt, kt, vt):
+def _rope(x, positions, theta):
+    """x: [B, S, H, Dh]; rotate pairs (single-call convenience)."""
+    cos, sin = _rope_tables(positions, x.shape[-1] // 2, theta, x.dtype)
+    return _rope_apply(x, cos, sin)
+
+
+def _sharded_flash(config: LlamaConfig, qt, kt, vt, layout: str = "bhsd"):
     """pallas_call does not auto-partition under GSPMD: without an explicit
     shard_map, jit would all-gather q/k/v to run the kernel replicated.
     Map the kernel over the mesh's batch/head axes (seq stays local here —
     the seq axis is the ring-attention path, parallel/ring_attention.py).
+
+    layout "bhsd": operands [B, H, S, Dh]; "bshd": model-native
+    [B, S, H, Dh] (no transposes anywhere — the kernel reads heads as
+    tile-aligned column blocks).
     """
     from dlrover_tpu.parallel.mesh import get_mesh
     from dlrover_tpu.parallel.sharding import logical_to_mesh_axes
 
+    fa = flash_attention if layout == "bhsd" else flash_attention_bshd
+
     def kernel(q, k, v):
-        return flash_attention(
+        return fa(
             q, k, v, causal=True,
             block_q=config.attn_block_q, block_k=config.attn_block_k,
             bwd_block_q=config.attn_bwd_block_q,
@@ -273,10 +298,14 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt):
         ("heads", "tensor"),
         ("kv_heads", "tensor"),
     )
-    q_spec = logical_to_mesh_axes(
-        ("batch", "heads", None, None), rules)
-    kv_spec = logical_to_mesh_axes(
-        ("batch", "kv_heads", None, None), rules)
+    if layout == "bhsd":
+        q_axes = ("batch", "heads", None, None)
+        kv_axes = ("batch", "kv_heads", None, None)
+    else:
+        q_axes = ("batch", None, "heads", None)
+        kv_axes = ("batch", None, "kv_heads", None)
+    q_spec = logical_to_mesh_axes(q_axes, rules)
+    kv_spec = logical_to_mesh_axes(kv_axes, rules)
     from dlrover_tpu.parallel import get_shard_map
 
     return get_shard_map()(
@@ -299,6 +328,12 @@ def _seq_axis_active() -> bool:
 
 def _attention(config: LlamaConfig, q, k, v):
     """q: [B,S,H,Dh], k/v: [B,S,KVH,Dh] -> [B,S,H,Dh]."""
+    if config.attn_impl == "bshd" and not _seq_axis_active():
+        # model-native layout end to end: no q/k/v/o transposes
+        q = shard_logical(q, ("batch", "seq", "heads", "head_dim"))
+        k = shard_logical(k, ("batch", "seq", "kv_heads", "head_dim"))
+        v = shard_logical(v, ("batch", "seq", "kv_heads", "head_dim"))
+        return _sharded_flash(config, q, k, v, layout="bshd")
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -311,15 +346,15 @@ def _attention(config: LlamaConfig, q, k, v):
 
         impl = "ulysses" if config.attn_impl == "ulysses" else "ring"
         out = sequence_sharded_attention(qt, kt, vt, impl=impl, causal=True)
-    elif config.attn_impl == "flash":
+    elif config.attn_impl in ("flash", "bshd"):
         out = _sharded_flash(config, qt, kt, vt)
     else:
         out = mha_reference(qt, kt, vt, causal=True)
     return out.transpose(0, 2, 1, 3)
 
 
-def _layer(config: LlamaConfig, x, layer_params, positions):
-    """One transformer block. x: [B,S,D]."""
+def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
+    """One transformer block. x: [B,S,D]; rope tables [B,S,Dh/2]."""
     p = layer_params
     dtype = x.dtype
     B, S, D = x.shape
@@ -329,8 +364,8 @@ def _layer(config: LlamaConfig, x, layer_params, positions):
     q = qdot(y, p["wq"].astype(dtype)).reshape(B, S, h, hd)
     k = qdot(y, p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
     v = qdot(y, p["wv"].astype(dtype)).reshape(B, S, kvh, hd)
-    q = _rope(q, positions, config.rope_theta)
-    k = _rope(k, positions, config.rope_theta)
+    q = _rope_apply(q, rope_cos, rope_sin)
+    k = _rope_apply(k, rope_cos, rope_sin)
     attn = _attention(config, q, k, v).reshape(B, S, h * hd)
     x = x + qdot(attn, p["wo"].astype(dtype))
     x = shard_logical(x, ("batch", "seq", "embed"))
@@ -369,7 +404,7 @@ def _stage_fn(config: LlamaConfig):
         "dots": jax.checkpoint_policies.dots_saveable,
     }[config.remat_policy]
     return stage_layer_scan(
-        lambda h, lp, pos: _layer(config, h, lp, pos),
+        lambda h, lp, cos, sin: _layer(config, h, lp, cos, sin),
         remat=config.remat,
         policy=policy,
     )
@@ -388,6 +423,8 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None,
 
     x = params["embed"].astype(dtype)[tokens]
     x = shard_logical(x, ("batch", "seq", "embed"))
+    cos, sin = _rope_tables(
+        positions, config.head_dim // 2, config.rope_theta, dtype)
 
     from dlrover_tpu.parallel.pipeline import pipe_size, pipeline_apply
 
@@ -397,11 +434,11 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None,
         # schedule inside the step (parallel/pipeline.py), embed/head
         # replicated across stages.
         x, aux_total = pipeline_apply(
-            stage_fn, params["layers"], x, positions,
+            stage_fn, params["layers"], x, cos, sin,
             n_microbatches=config.pipe_microbatches,
         )
     else:
-        x, aux_total = stage_fn(params["layers"], x, positions)
+        x, aux_total = stage_fn(params["layers"], x, cos, sin)
 
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
     logits = x @ params["lm_head"].astype(dtype)
@@ -427,6 +464,8 @@ def _llama_1f1b_loss(config: LlamaConfig, params, tokens):
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     x = params["embed"].astype(dtype)[inputs]
     x = shard_logical(x, ("batch", "seq", "embed"))
+    cos, sin = _rope_tables(
+        positions, config.head_dim // 2, config.rope_theta, dtype)
 
     # Global valid-token normalizer, computed from the labels BEFORE the
     # schedule: per-microbatch normalization would weight tokens in
@@ -448,7 +487,7 @@ def _llama_1f1b_loss(config: LlamaConfig, params, tokens):
     }
     return pipeline_loss_1f1b(
         _stage_fn(config), last_fn, params["layers"], last_params, x,
-        stage_extras=(positions,), last_extras=(labels,),
+        stage_extras=(cos, sin), last_extras=(labels,),
         n_microbatches=config.pipe_microbatches,
     )
 

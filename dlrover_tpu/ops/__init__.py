@@ -10,6 +10,7 @@ execution on CPU for tests.
 
 from dlrover_tpu.ops.attention import (  # noqa: F401
     flash_attention,
+    flash_attention_bshd,
     mha_reference,
 )
 from dlrover_tpu.ops.cross_entropy import (  # noqa: F401

@@ -1,16 +1,43 @@
-"""Flash attention as a Pallas TPU kernel (FlashAttention-2 schedule).
+"""Flash attention as Pallas TPU kernels (FlashAttention-2 schedule).
 
 Equivalent capability: the reference wraps the flash-attn CUDA package
 (atorch/atorch/modules/transformer/layers.py:1168 flash_attn_with_mask_bias,
-:1279 FlashAttnModule). TPU redesign: a Mosaic kernel — grid over
-(batch, head, q-block, kv-block) with the kv dimension innermost so VMEM
-scratch carries the running softmax statistics (m, l) and the output
-accumulator across kv blocks; the MXU does the two matmuls per block in
-bf16 with fp32 accumulation. Backward recomputes scores blockwise from the
-saved logsumexp (no S x S materialisation), the standard FA2 dq/dkv split.
+:1279 FlashAttnModule). TPU redesign — two ideas beyond the usual FA2
+tiling:
 
-GQA: the kv-head index is derived from the q-head grid index in the
-BlockSpec index maps — grouped kv is never materialised in the forward.
+1. **Packed (scalar-prefetch) grids.** Causal attention only touches the
+   lower-triangular tiles, but a rectangular Pallas grid still *schedules*
+   the dead j>i tiles and DMAs their blocks even when a predicate skips
+   the compute. Instead, the set of live (q-block, kv-block) pairs is
+   enumerated at trace time into a small int32 table that rides the
+   scalar-prefetch channel (`pltpu.PrefetchScalarGridSpec`); the grid's
+   last dimension walks that table, so dead tiles are never scheduled and
+   never fetched — ~2x fewer tile steps for causal at no numeric cost.
+   The same table carries first/last flags that replace the static
+   ``j == 0`` / ``j == nk-1`` init/finalise conditions.
+
+2. **BSHD-native layout.** The transformer's residual stream produces
+   q/k/v as [B, S, H*Dh] (one matmul output, heads folded in the minor
+   dim). The classic [B, H, S, Dh] kernel layout forces a transpose of
+   every q/k/v/o at every layer — and their mirror copies in the
+   backward. With Dh a multiple of the 128-lane tile, head ``h`` of a
+   [B, S, H*Dh] array is a *tile-aligned column block*: BlockSpec
+   ``(1, block_q, Dh)`` indexed ``(b, i, h)`` reads it directly. The
+   ``layout="bshd"`` kernels (used via :func:`flash_attention_bshd`) run
+   on that layout with zero data movement on either side; the legacy
+   [B, H, S, Dh] entry :func:`flash_attention` shares the same kernel
+   bodies with 4-D BlockSpecs.
+
+Numerics: grid over (batch, head, packed-tile); VMEM scratch carries the
+running softmax statistics (m, l) and the fp32 output accumulator across
+a row's kv tiles; the MXU does the two matmuls per tile in the input
+dtype with fp32 accumulation. Backward recomputes scores blockwise from
+the saved logsumexp (no S x S materialisation) — the standard FA2 dq/dkv
+split, each with its own packed grid (dq walks q-major, dkv kv-major).
+
+GQA: the kv-head index is derived from the q-head grid index inside the
+BlockSpec index maps — grouped kv is never materialised in the forward;
+the backward produces per-q-head dk/dv and group-sums outside.
 
 On non-TPU backends the same kernels run in Pallas interpret mode, so the
 unit-test suite exercises the real kernel code paths on the CPU mesh.
@@ -22,6 +49,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -34,6 +62,7 @@ def _block_mask(shape, i, j, *, block_q, block_k, causal, q_len, kv_len):
     Causality is end-aligned (offset = kv_len - q_len), matching
     mha_reference's tril(k_len - q_len); rows/cols beyond the true
     lengths are masked so non-block-multiple shapes stay exact.
+    ``i``/``j`` may be traced scalars (read from the packed-tile table).
     Returns None when every position is trivially valid."""
     pad_rows = q_len % block_q != 0
     pad_cols = kv_len % block_k != 0
@@ -78,20 +107,65 @@ def _compiler_params(dims):
         return None
 
 
+def _t2(ref):
+    """Load a block and squeeze the leading unit dims to [rows, cols]."""
+    x = ref[...]
+    return x.reshape(x.shape[-2], x.shape[-1])
+
+
+def _wr(ref, val):
+    ref[...] = val.reshape(ref.shape).astype(ref.dtype)
+
+
 # ---------------------------------------------------------------------------
-# forward
+# packed tile enumeration
 # ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_meta(nq, nk, block_q, block_k, q_len, kv_len, causal, kv_major):
+    """int32 [4, T] table of live tiles: rows (i, j, first, last).
+
+    ``first``/``last`` mark the boundaries of each accumulation group
+    (a q-block row for q-major order, a kv-block column for kv-major).
+    A group with no live tile keeps one fully-masked placeholder so its
+    output block is still initialised and written."""
+    offset = kv_len - q_len
+
+    def live(i, j):
+        return (not causal) or (j * block_k < offset + (i + 1) * block_q)
+
+    rows = []
+    if not kv_major:
+        for i in range(nq):
+            js = [j for j in range(nk) if live(i, j)] or [0]
+            for n, j in enumerate(js):
+                rows.append((i, j, n == 0, n == len(js) - 1))
+    else:
+        for j in range(nk):
+            iis = [i for i in range(nq) if live(i, j)] or [nq - 1]
+            for n, i in enumerate(iis):
+                rows.append((i, j, n == 0, n == len(iis) - 1))
+    return np.asarray(
+        [
+            [r[0] for r in rows],
+            [r[1] for r in rows],
+            [int(r[2]) for r in rows],
+            [int(r[3]) for r in rows],
+        ],
+        dtype=np.int32,
+    )
 
 
 def _needs_mask_static(causal, block_q, block_k, q_len, kv_len):
-    """Whether ANY block can need masking (padding is static)."""
+    """Whether ANY tile can need masking (padding is static)."""
     return causal or q_len % block_q != 0 or kv_len % block_k != 0
 
 
 def _mask_needed(i, j, *, causal, block_q, block_k, q_len, kv_len):
-    """Dynamic predicate: this block contains masked positions — it
+    """Dynamic predicate: this tile contains masked positions — it
     crosses the causal diagonal or is a padded edge block. Interior
-    blocks skip all mask VPU work."""
+    tiles skip all mask VPU work."""
     need = jnp.bool_(False)
     if causal:
         offset = kv_len - q_len
@@ -103,41 +177,106 @@ def _mask_needed(i, j, *, causal, block_q, block_k, q_len, kv_len):
     return need
 
 
-def _dispatch_tile(run, tile, i, j, *, causal, block_q, block_k, q_len,
-                   kv_len):
-    """Invoke ``tile(masked)`` under the ``run`` predicate, selecting the
-    mask-free variant for blocks that cannot contain masked positions."""
+def _dispatch_tile(tile, i, j, *, causal, block_q, block_k, q_len, kv_len):
+    """Invoke ``tile(masked)``, selecting the mask-free variant for tiles
+    that cannot contain masked positions. Every scheduled tile is live
+    (the packed grid already excluded dead ones)."""
     if _needs_mask_static(causal, block_q, block_k, q_len, kv_len):
         need = _mask_needed(i, j, causal=causal, block_q=block_q,
                             block_k=block_k, q_len=q_len, kv_len=kv_len)
-        pl.when(run & need)(lambda: tile(True))
-        pl.when(run & jnp.logical_not(need))(lambda: tile(False))
+        pl.when(need)(lambda: tile(True))
+        pl.when(jnp.logical_not(need))(lambda: tile(False))
     else:
-        pl.when(run)(lambda: tile(False))
+        tile(False)
+
+
+# ---------------------------------------------------------------------------
+# layout plumbing
+# ---------------------------------------------------------------------------
+#
+# "bhsd": q [B, H, S, Dh], kv [B, KVH, S, Dh]     (legacy / Ulysses path)
+# "bshd": q [B, S, H*Dh],  kv [B, S, KVH*Dh]      (model-native, rank 3)
+#
+# lse/delta are [B, H, S, 1] in both layouts.
+
+
+def _fa_dims(layout, q, k, heads, kv_heads):
+    if layout == "bhsd":
+        batch, H, q_len, head_dim = q.shape
+        KVH, kv_len = k.shape[1], k.shape[2]
+    else:
+        batch, q_len, qd = q.shape
+        H, KVH = heads, kv_heads
+        head_dim = qd // H
+        kv_len = k.shape[1]
+    return batch, H, KVH, q_len, kv_len, head_dim
+
+
+def _io_specs(layout, *, block_q, block_k, head_dim, group):
+    """(q_spec, kv_spec, row_spec): block geometries for the packed grid.
+
+    Index maps receive (b, h, t, meta); meta[0, t] is the q-block index,
+    meta[1, t] the kv-block index of packed tile ``t``."""
+    if layout == "bhsd":
+        q_spec = pl.BlockSpec(
+            (1, 1, block_q, head_dim),
+            lambda b, h, t, m: (b, h, m[0, t], 0),
+        )
+        kv_spec = pl.BlockSpec(
+            (1, 1, block_k, head_dim),
+            lambda b, h, t, m: (b, h // group, m[1, t], 0),
+        )
+    else:
+        q_spec = pl.BlockSpec(
+            (1, block_q, head_dim),
+            lambda b, h, t, m: (b, m[0, t], h),
+        )
+        kv_spec = pl.BlockSpec(
+            (1, block_k, head_dim),
+            lambda b, h, t, m: (b, m[1, t], h // group),
+        )
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, t, m: (b, h, m[0, t], 0)
+    )
+    return q_spec, kv_spec, row_spec
+
+
+def _kv_out(layout, *, block_k, head_dim):
+    """Per-q-head dk/dv output spec (kv geometry, indexed by q head)."""
+    if layout == "bhsd":
+        return pl.BlockSpec(
+            (1, 1, block_k, head_dim), lambda b, h, t, m: (b, h, m[1, t], 0)
+        )
+    return pl.BlockSpec(
+        (1, block_k, head_dim), lambda b, h, t, m: (b, m[1, t], h)
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, block_q, block_k, num_kv_blocks, q_len, kv_len,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len,
 ):
-    i = pl.program_id(2)
-    j = pl.program_id(3)
+    t = pl.program_id(2)
+    i = meta_ref[0, t]
+    j = meta_ref[1, t]
 
-    @pl.when(j == 0)
+    @pl.when(meta_ref[2, t] == 1)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    offset = kv_len - q_len
-    run = (j * block_k < offset + (i + 1) * block_q) if causal else (j >= 0)
-
     def _tile(masked):
         # sm_scale folded into the q tile: one [bq, d] multiply instead
         # of a [bq, bk] multiply on the score matrix
-        q = q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype)
-        k = _zero_pad_rows(k_ref[0, 0], j, block_k, kv_len)
+        q = _t2(q_ref) * jnp.asarray(sm_scale, q_ref.dtype)
+        k = _zero_pad_rows(_t2(k_ref), j, block_k, kv_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -160,7 +299,7 @@ def _fwd_kernel(
             # and exp(s - m_new) == 1 would pollute l
             p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        v = _zero_pad_rows(v_ref[0, 0], j, block_k, kv_len)
+        v = _zero_pad_rows(_t2(v_ref), j, block_k, kv_len)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -169,68 +308,61 @@ def _fwd_kernel(
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    _dispatch_tile(run, _tile, i, j, causal=causal, block_q=block_q,
+    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
                    block_k=block_k, q_len=q_len, kv_len=kv_len)
 
-    @pl.when(j == num_kv_blocks - 1)
+    @pl.when(meta_ref[3, t] == 1)
     def _final():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        _wr(o_ref, acc_scr[:] / l_safe)
         lse = m_scr[:, :1] + jnp.log(jnp.maximum(l_safe, 1e-30))
-        lse_ref[0, 0] = lse.astype(lse_ref.dtype)
+        _wr(lse_ref, lse)
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    batch, heads, q_len, head_dim = q.shape
-    kv_heads, kv_len = k.shape[1], k.shape[2]
-    group = heads // kv_heads
+def _fwd(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
+         block_k, interpret):
+    batch, H, KVH, q_len, kv_len, head_dim = _fa_dims(
+        layout, q, k, heads, kv_heads)
+    group = H // KVH
     block_q = min(block_q, q_len)
     block_k = min(block_k, kv_len)
-    grid = (batch, heads, pl.cdiv(q_len, block_q), pl.cdiv(kv_len, block_k))
+    nq = pl.cdiv(q_len, block_q)
+    nk = pl.cdiv(kv_len, block_k)
+    meta = jnp.asarray(_tile_meta(
+        nq, nk, block_q, block_k, q_len, kv_len, causal, False))
 
     kernel = functools.partial(
         _fwd_kernel,
-        sm_scale=sm_scale,
-        causal=causal,
-        block_q=block_q,
-        block_k=block_k,
-        num_kv_blocks=grid[3],
-        q_len=q_len,
-        kv_len=kv_len,
+        sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
     )
-    out_shape = (
-        jax.ShapeDtypeStruct(q.shape, q.dtype),
-        jax.ShapeDtypeStruct((batch, heads, q_len, 1), jnp.float32),
-    )
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, head_dim),
-                         lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, head_dim),
-                         lambda b, h, i, j: (b, h // group, j, 0)),
-            pl.BlockSpec((1, 1, block_k, head_dim),
-                         lambda b, h, i, j: (b, h // group, j, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, 1, block_q, head_dim),
-                         lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b, h, i, j: (b, h, i, 0)),
-        ),
+    q_spec, kv_spec, row_spec = _io_specs(
+        layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
+        group=group)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, H, meta.shape[1]),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=(q_spec, row_spec),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, head_dim), jnp.float32),
         ],
-        out_shape=out_shape,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, H, q_len, 1), jnp.float32),
+        ),
         compiler_params=_compiler_params(
-            ("parallel", "parallel", "parallel", "arbitrary")
+            ("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(meta, q, k, v)
     return o, lse
 
 
@@ -240,29 +372,27 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_scr,
-    *, sm_scale, causal, block_q, block_k, num_kv_blocks, q_len, kv_len,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len,
 ):
-    i = pl.program_id(2)
-    j = pl.program_id(3)
+    t = pl.program_id(2)
+    i = meta_ref[0, t]
+    j = meta_ref[1, t]
 
-    @pl.when(j == 0)
+    @pl.when(meta_ref[2, t] == 1)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
-
-    offset = kv_len - q_len
-    run = (j * block_k < offset + (i + 1) * block_q) if causal else (j >= 0)
 
     def _tile(masked):
         # scaled-q trick: s uses q*sm_scale; ds stays unscaled and the
         # final dq is scaled once (dq = scale * ds @ k)
-        q = q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype)
-        k = _zero_pad_rows(k_ref[0, 0], j, block_k, kv_len)
-        v = _zero_pad_rows(v_ref[0, 0], j, block_k, kv_len)
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        q = _t2(q_ref) * jnp.asarray(sm_scale, q_ref.dtype)
+        k = _zero_pad_rows(_t2(k_ref), j, block_k, kv_len)
+        v = _zero_pad_rows(_t2(v_ref), j, block_k, kv_len)
+        do = _t2(do_ref)
+        lse = _t2(lse_ref)
+        delta = _t2(delta_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -288,40 +418,39 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    _dispatch_tile(run, _tile, i, j, causal=causal, block_q=block_q,
+    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
                    block_k=block_k, q_len=q_len, kv_len=kv_len)
 
-    @pl.when(j == num_kv_blocks - 1)
+    @pl.when(meta_ref[3, t] == 1)
     def _final():
-        dq_ref[0, 0] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
+        _wr(dq_ref, dq_scr[:] * sm_scale)
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, sm_scale, causal, block_q, block_k, num_q_blocks, q_len, kv_len,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len,
 ):
-    j = pl.program_id(2)  # kv block
-    i = pl.program_id(3)  # q block (innermost: accumulate over q)
+    t = pl.program_id(2)
+    i = meta_ref[0, t]
+    j = meta_ref[1, t]
 
-    @pl.when(i == 0)
+    @pl.when(meta_ref[2, t] == 1)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    offset = kv_len - q_len
-    run = (offset + (i + 1) * block_q > j * block_k) if causal else (i >= 0)
-
     def _tile(masked):
         # scaled-q trick: the scaled q tile serves both s = (q*scale)@k
         # and dk += ds^T (q*scale), so ds itself never needs scaling
-        q = _zero_pad_rows(q_ref[0, 0], i, block_q, q_len)
+        q = _zero_pad_rows(_t2(q_ref), i, block_q, q_len)
         q = q * jnp.asarray(sm_scale, q.dtype)
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = _zero_pad_rows(do_ref[0, 0], i, block_q, q_len)
-        lse = lse_ref[0, 0]
-        delta = _zero_pad_rows(delta_ref[0, 0], i, block_q, q_len)
+        k = _t2(k_ref)
+        v = _t2(v_ref)
+        do = _zero_pad_rows(_t2(do_ref), i, block_q, q_len)
+        lse = _t2(lse_ref)
+        delta = _zero_pad_rows(_t2(delta_ref), i, block_q, q_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -353,91 +482,108 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    _dispatch_tile(run, _tile, i, j, causal=causal, block_q=block_q,
+    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
                    block_k=block_k, q_len=q_len, kv_len=kv_len)
 
-    @pl.when(i == num_q_blocks - 1)
+    @pl.when(meta_ref[3, t] == 1)
     def _final():
-        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+        _wr(dk_ref, dk_scr[:])
+        _wr(dv_ref, dv_scr[:])
 
 
-def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
+         interpret, res, do):
     q, k, v, o, lse = res
-    batch, heads, q_len, head_dim = q.shape
-    kv_heads, kv_len = k.shape[1], k.shape[2]
-    group = heads // kv_heads
+    batch, H, KVH, q_len, kv_len, head_dim = _fa_dims(
+        layout, q, k, heads, kv_heads)
+    group = H // KVH
     block_q = min(block_q, q_len)
     block_k = min(block_k, kv_len)
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
 
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
-    )
+    # delta = rowsum(do * o) per head, laid out [B, H, S, 1]
+    dof = do.astype(jnp.float32) * o.astype(jnp.float32)
+    if layout == "bhsd":
+        delta = jnp.sum(dof, axis=-1, keepdims=True)
+    else:
+        delta = dof.reshape(batch, q_len, H, head_dim).sum(-1)
+        delta = delta.transpose(0, 2, 1)[..., None]
 
-    q_spec = pl.BlockSpec((1, 1, block_q, head_dim),
-                          lambda b, h, i, j: (b, h, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, block_k, head_dim),
-                           lambda b, h, i, j: (b, h // group, j, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+    q_spec, kv_spec, row_spec = _io_specs(
+        layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
+        group=group)
 
+    meta_q = jnp.asarray(_tile_meta(
+        nq, nk, block_q, block_k, q_len, kv_len, causal, False))
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_kv_blocks=nk,
-            q_len=q_len, kv_len=kv_len,
+            block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
         ),
-        grid=(batch, heads, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
-        out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, H, meta_q.shape[1]),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         compiler_params=_compiler_params(
-            ("parallel", "parallel", "parallel", "arbitrary")
+            ("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(meta_q, q, k, v, do, lse, delta)
 
-    # dk/dv are produced per q-head, then group-summed for GQA.
-    q_spec2 = pl.BlockSpec((1, 1, block_q, head_dim),
-                           lambda b, h, j, i: (b, h, i, 0))
-    kv_spec2 = pl.BlockSpec((1, 1, block_k, head_dim),
-                            lambda b, h, j, i: (b, h // group, j, 0))
-    kv_out_spec = pl.BlockSpec((1, 1, block_k, head_dim),
-                               lambda b, h, j, i: (b, h, j, 0))
-    row_spec2 = pl.BlockSpec((1, 1, block_q, 1),
-                             lambda b, h, j, i: (b, h, i, 0))
+    # dk/dv are produced per q-head (packed kv-major), then group-summed
+    # for GQA.
+    meta_kv = jnp.asarray(_tile_meta(
+        nq, nk, block_q, block_k, q_len, kv_len, causal, True))
+    if layout == "bhsd":
+        kv_out_shape = (batch, H, kv_len, head_dim)
+    else:
+        kv_out_shape = (batch, kv_len, H * head_dim)
+    kv_out_spec = _kv_out(layout, block_k=block_k, head_dim=head_dim)
     dk_full, dv_full = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_q_blocks=nq,
-            q_len=q_len, kv_len=kv_len,
+            block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
         ),
-        grid=(batch, heads, nk, nq),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
-        out_specs=(kv_out_spec, kv_out_spec),
-        scratch_shapes=[
-            pltpu.VMEM((block_k, head_dim), jnp.float32),
-            pltpu.VMEM((block_k, head_dim), jnp.float32),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, H, meta_kv.shape[1]),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=(kv_out_spec, kv_out_spec),
+            scratch_shapes=[
+                pltpu.VMEM((block_k, head_dim), jnp.float32),
+                pltpu.VMEM((block_k, head_dim), jnp.float32),
+            ],
+        ),
         out_shape=(
-            jax.ShapeDtypeStruct((batch, heads, kv_len, head_dim), q.dtype),
-            jax.ShapeDtypeStruct((batch, heads, kv_len, head_dim), q.dtype),
+            jax.ShapeDtypeStruct(kv_out_shape, q.dtype),
+            jax.ShapeDtypeStruct(kv_out_shape, q.dtype),
         ),
         compiler_params=_compiler_params(
-            ("parallel", "parallel", "parallel", "arbitrary")
+            ("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(meta_kv, q, k, v, do, lse, delta)
 
     if group > 1:
-        dk = dk_full.reshape(
-            batch, kv_heads, group, kv_len, head_dim
-        ).sum(axis=2).astype(k.dtype)
-        dv = dv_full.reshape(
-            batch, kv_heads, group, kv_len, head_dim
-        ).sum(axis=2).astype(v.dtype)
+        if layout == "bhsd":
+            dk = dk_full.reshape(
+                batch, KVH, group, kv_len, head_dim).sum(axis=2)
+            dv = dv_full.reshape(
+                batch, KVH, group, kv_len, head_dim).sum(axis=2)
+        else:
+            dk = dk_full.reshape(
+                batch, kv_len, KVH, group, head_dim
+            ).sum(axis=3).reshape(batch, kv_len, KVH * head_dim)
+            dv = dv_full.reshape(
+                batch, kv_len, KVH, group, head_dim
+            ).sum(axis=3).reshape(batch, kv_len, KVH * head_dim)
+        dk = dk.astype(k.dtype)
+        dv = dv.astype(v.dtype)
     else:
         dk, dv = dk_full, dv_full
     return dq, dk, dv
@@ -457,23 +603,22 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
 # happen at the primal level.
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11)
-)
-def _anchor(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
-            bwd_block_q, bwd_block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(5, 15)))
+def _anchor(q, k, v, o, lse, layout, heads, kv_heads, sm_scale, causal,
+            block_q, block_k, bwd_block_q, bwd_block_k, interpret):
     return o
 
 
-def _anchor_fwd(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
-                bwd_block_q, bwd_block_k, interpret):
+def _anchor_fwd(q, k, v, o, lse, layout, heads, kv_heads, sm_scale, causal,
+                block_q, block_k, bwd_block_q, bwd_block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _anchor_bwd(sm_scale, causal, block_q, block_k, bwd_block_q,
-                bwd_block_k, interpret, res, do):
+def _anchor_bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
+                bwd_block_q, bwd_block_k, interpret, res, do):
     dq, dk, dv = _bwd(
-        sm_scale, causal, bwd_block_q, bwd_block_k, interpret, res, do
+        layout, heads, kv_heads, sm_scale, causal, bwd_block_q, bwd_block_k,
+        interpret, res, do,
     )
     _, _, _, o, lse = res
     return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
@@ -482,8 +627,8 @@ def _anchor_bwd(sm_scale, causal, block_q, block_k, bwd_block_q,
 _anchor.defvjp(_anchor_fwd, _anchor_bwd)
 
 
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, bwd_block_q,
-           bwd_block_k, interpret):
+def _flash(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
+           block_k, bwd_block_q, bwd_block_k, interpret):
     from jax.ad_checkpoint import checkpoint_name
 
     # stop_gradient on the *inputs* keeps AD tracing out of the pallas
@@ -491,13 +636,14 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, bwd_block_q,
     # the anchor's q/k/v arguments.
     o, lse = _fwd(
         jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
-        jax.lax.stop_gradient(v), sm_scale, causal, block_q, block_k,
-        interpret,
+        jax.lax.stop_gradient(v), layout, heads, kv_heads, sm_scale, causal,
+        block_q, block_k, interpret,
     )
     o = checkpoint_name(o, "attn_out")
     lse = checkpoint_name(lse, "attn_out")
-    return _anchor(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
-                   bwd_block_q, bwd_block_k, interpret)
+    return _anchor(q, k, v, o, lse, layout, heads, kv_heads, sm_scale,
+                   causal, block_q, block_k, bwd_block_q, bwd_block_k,
+                   interpret)
 
 
 def flash_attention(
@@ -510,7 +656,7 @@ def flash_attention(
     bwd_block_k: int | None = None,
     interpret: bool | None = None,
 ):
-    """Multi-head attention, O(S) memory, MXU-tiled.
+    """Multi-head attention, O(S) memory, MXU-tiled ([B,H,S,Dh] layout).
 
     Args:
       q: [batch, heads, q_len, head_dim]
@@ -523,13 +669,64 @@ def flash_attention(
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if q.shape[1] % k.shape[1] != 0:
-        raise ValueError(f"q heads {q.shape[1]} not divisible by kv {k.shape[1]}")
+        raise ValueError(
+            f"q heads {q.shape[1]} not divisible by kv {k.shape[1]}")
     if interpret is None:
         interpret = _use_interpret()
-    return _flash(q, k, v, float(sm_scale), bool(causal),
+    return _flash(q, k, v, "bhsd", int(q.shape[1]), int(k.shape[1]),
+                  float(sm_scale), bool(causal),
                   int(block_q), int(block_k),
                   int(bwd_block_q or block_q), int(bwd_block_k or block_k),
                   bool(interpret))
+
+
+def flash_attention_bshd(
+    q, k, v,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
+    interpret: bool | None = None,
+):
+    """Flash attention on the model-native [B, S, H, Dh] layout.
+
+    No transposes on either side: internally the heads fold into the
+    minor dimension ([B, S, H*Dh], a free bitcast of the projection
+    output) and each head is read as a tile-aligned 128-lane column
+    block. Requires head_dim % 128 == 0 on hardware (lane-tile
+    alignment); other head dims transparently fall back to the
+    transposing [B,H,S,Dh] path.
+
+    Args:
+      q: [batch, q_len, heads, head_dim]
+      k, v: [batch, kv_len, kv_heads, head_dim]; heads % kv_heads == 0.
+    Returns [batch, q_len, heads, head_dim] in q.dtype.
+    """
+    B, S, H, hd = q.shape
+    KVH, Skv = k.shape[2], k.shape[1]
+    if H % KVH != 0:
+        raise ValueError(f"q heads {H} not divisible by kv {KVH}")
+    if sm_scale is None:
+        sm_scale = hd ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    if not interpret and hd % 128 != 0:
+        o = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, bwd_block_q=bwd_block_q,
+            bwd_block_k=bwd_block_k, interpret=interpret,
+        )
+        return o.transpose(0, 2, 1, 3)
+    o3 = _flash(
+        q.reshape(B, S, H * hd), k.reshape(B, Skv, KVH * hd),
+        v.reshape(B, Skv, KVH * hd), "bshd", int(H), int(KVH),
+        float(sm_scale), bool(causal), int(block_q), int(block_k),
+        int(bwd_block_q or block_q), int(bwd_block_k or block_k),
+        bool(interpret))
+    return o3.reshape(B, S, H, hd)
 
 
 def mha_reference(q, k, v, causal: bool = True, sm_scale: float | None = None):

@@ -8,7 +8,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dlrover_tpu.ops.attention import flash_attention, mha_reference
+from dlrover_tpu.ops.attention import (
+    flash_attention,
+    flash_attention_bshd,
+    mha_reference,
+)
 from dlrover_tpu.ops.cross_entropy import (
     softmax_cross_entropy,
     vocab_parallel_cross_entropy,
@@ -53,6 +57,43 @@ def test_flash_attention_gqa_heads():
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
     ref = mha_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("heads,kv_heads", [(4, 4), (8, 2)])
+def test_flash_attention_bshd_forward(causal, heads, kv_heads):
+    """The model-native [B,S,H,Dh] kernels match the BHSD reference."""
+    q, k, v = _qkv(heads=heads, kv_heads=kv_heads)
+    qs, ks, vs = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = flash_attention_bshd(qs, ks, vs, causal=causal,
+                               block_q=64, block_k=64)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out.transpose(0, 2, 1, 3)), np.asarray(ref), atol=2e-2
+    )
+
+
+@pytest.mark.parametrize("q_len,kv_len", [(128, 128), (96, 200)])
+def test_flash_attention_bshd_grads_match_reference(q_len, kv_len):
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(2, 8, q_len, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 2, kv_len, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 2, kv_len, 64), jnp.float32)
+
+    def loss_bshd(q, k, v):
+        o = flash_attention_bshd(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), block_q=64, block_k=64)
+        return jnp.sum(o ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v) ** 2)
+
+    g = jax.grad(loss_bshd, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        scale = float(jnp.max(jnp.abs(b))) + 1e-6
+        assert float(jnp.max(jnp.abs(a - b))) / scale < 5e-2
 
 
 def test_softmax_cross_entropy_matches_optax():

@@ -1,0 +1,59 @@
+"""Time the real nano-350m train step on the TPU chip.
+
+Usage: python bench_step.py [attn_impl] [block_q] [block_k] [bwd_q] [bwd_k]
+"""
+import dataclasses
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models import (
+        PRESETS, llama_init, llama_logical_axes, llama_loss_fn,
+    )
+    from dlrover_tpu.parallel import MeshConfig, Strategy, auto_accelerate
+
+    impl = sys.argv[1] if len(sys.argv) > 1 else "flash"
+    bq = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    bk = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+    bwq = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+    bwk = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+
+    config = dataclasses.replace(
+        PRESETS["nano-350m"], attn_impl=impl, attn_block_q=bq,
+        attn_block_k=bk, attn_bwd_block_q=bwq, attn_bwd_block_k=bwk)
+    batch, seq, steps = 8, 2048, 30
+
+    strategy = Strategy(
+        mesh=MeshConfig(data=1, fsdp=1), compute_dtype="bfloat16",
+        remat="none", donate=True)
+    res = auto_accelerate(
+        llama_loss_fn(config), lambda rng: llama_init(config, rng),
+        optax.adafactor(1e-3), llama_logical_axes(config),
+        strategy=strategy, devices=jax.devices()[:1])
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, config.vocab_size, (batch, seq + 1)))
+    state = res.state
+    state, m = res.train_step(state, {"tokens": tokens}, jax.random.key(0))
+    _ = float(m["loss"])
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = res.train_step(state, {"tokens": tokens}, jax.random.key(i))
+    _ = float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    params = sum(x.size for x in jax.tree.leaves(state.params))
+    flops = 6 * params * batch * seq + (
+        12 * config.n_layers * config.dim * batch * seq * seq // 2)
+    print(f"impl={sys.argv[1] if len(sys.argv) > 1 else impl} "
+          f"blocks=({bq},{bk},{bwq},{bwk}) "
+          f"step={dt*1e3:.1f} ms tok/s={batch*seq/dt:.0f} "
+          f"mfu={flops/dt/197e12*100:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

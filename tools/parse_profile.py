@@ -1,0 +1,37 @@
+"""Parse an existing xplane trace into per-op/category self-times."""
+import glob
+import json
+import sys
+
+from xprof.convert import raw_to_tool_data as rtd
+
+paths = glob.glob("/root/repo/_profile_out/**/*.xplane.pb", recursive=True)
+data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
+if isinstance(data, bytes):
+    data = data.decode()
+obj = json.loads(data)
+cols = [c["label"] for c in obj["cols"]]
+rows = [[c["v"] for c in r["c"]] for r in obj["rows"]]
+icat = cols.index("HLO op category")
+iname = cols.index("HLO op name")
+itime = cols.index("Total self time (us)")
+iocc = cols.index("#Occurrences")
+
+steps = 3
+bycat = {}
+byop = {}
+for r in rows:
+    t = float(r[itime] or 0)
+    bycat[r[icat]] = bycat.get(r[icat], 0.0) + t
+    byop.setdefault((r[icat], r[iname]), [0.0, 0])
+    byop[(r[icat], r[iname])][0] += t
+    byop[(r[icat], r[iname])][1] += int(r[iocc] or 0)
+
+tot = sum(bycat.values())
+print(f"total self time {tot/steps/1e3:.1f} ms/step")
+print("\n=== by category ===")
+for cat, t in sorted(bycat.items(), key=lambda kv: -kv[1]):
+    print(f"{t/steps/1e3:8.2f} ms/step  {cat}")
+print("\n=== top 45 ops ===")
+for (cat, name), (t, occ) in sorted(byop.items(), key=lambda kv: -kv[1][0])[:45]:
+    print(f"{t/steps/1e3:8.3f} ms/step  x{occ:4d} {cat:22s} {name[:80]}")
