@@ -226,6 +226,26 @@ def write_host_shard(storage, path: str, meta: CheckpointMeta, data) -> None:
     )
 
 
+def read_host_shard_meta(
+    path: str,
+) -> tuple[CheckpointMeta, int] | None:
+    """Read ONLY the pickled meta of a ``.dlck`` host-shard file.
+
+    Returns (meta, payload_start_offset). The payload stays on disk so
+    restores can ``np.memmap`` exactly the byte ranges a target shard
+    intersects (scalable resharded restore — the full-file read of
+    :func:`read_host_shard` materialises every saved byte). Slice reads
+    cannot verify the whole-payload CRC without defeating their point;
+    the eager path keeps the check.
+    """
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        meta_len = int.from_bytes(f.read(_META_LEN_SIZE), "little")
+        meta = pickle.loads(f.read(meta_len))
+    return meta, _META_LEN_SIZE + meta_len
+
+
 def read_host_shard(path: str) -> tuple[CheckpointMeta, bytes] | None:
     if not os.path.exists(path):
         return None

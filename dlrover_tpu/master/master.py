@@ -14,6 +14,7 @@ import time
 from dlrover_tpu.common.constants import (
     JobConstant,
     JobExitReason,
+    NodeType,
     RendezvousName,
 )
 from dlrover_tpu.common.log import get_logger
@@ -190,6 +191,26 @@ class DistributedJobMaster(JobMaster):
             target_worker_num=getattr(job_args, "node_num", 0) or 0,
             node_unit=getattr(job_args, "node_unit", 1) or 1,
         )
+        # Manual scaling via ScalePlan CRs (reference k8s_watcher.py:226):
+        # only meaningful when the scaler talks to a real API server.
+        self.scaleplan_watcher = None
+        k8s_client = getattr(scaler, "_client", None)
+        if k8s_client is not None and hasattr(
+            k8s_client, "list_custom_resources"
+        ):
+            from dlrover_tpu.master.scaleplan_watcher import (
+                ScalePlanWatcher,
+            )
+
+            def _apply(plan, _self=self):
+                _self.auto_scaler.execute_job_optimization_plan(plan)
+                group = plan.node_group_resources.get(NodeType.WORKER)
+                if group is not None:
+                    _self.auto_scaler.on_group_count_applied(group.count)
+
+            self.scaleplan_watcher = ScalePlanWatcher(
+                job_args.job_name, k8s_client, _apply
+            )
         self.paral_generator = ParalConfigGenerator(
             self.job_manager,
             self.task_manager.speed_monitor,
@@ -222,6 +243,8 @@ class DistributedJobMaster(JobMaster):
         self.job_manager.start()
         if getattr(self._job_args, "auto_scaling", True):
             self.auto_scaler.start_auto_scaling()
+        if self.scaleplan_watcher is not None:
+            self.scaleplan_watcher.start()
         if getattr(self._job_args, "auto_tunning", False):
             self.paral_generator.start()
         self.metric_collector.start()
@@ -275,6 +298,8 @@ class DistributedJobMaster(JobMaster):
     def stop(self):
         self.metric_collector.stop()
         self.paral_generator.stop()
+        if self.scaleplan_watcher is not None:
+            self.scaleplan_watcher.stop()
         self.auto_scaler.stop_auto_scaling()
         self.task_manager.stop()
         self.job_manager.stop()

@@ -32,7 +32,7 @@ from dlrover_tpu.ops.attention import (
     mha_reference,
 )
 from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
-from dlrover_tpu.ops.fp8 import qdot
+from dlrover_tpu.ops.fp8 import fp8_enabled, qdot
 from dlrover_tpu.parallel.sharding import shard_logical
 
 
@@ -74,6 +74,11 @@ class LlamaConfig:
     # pipeline_parallel_optimization.py:98). "1f1b" affects the
     # training loss path only; plain forwards always use gpipe.
     pipe_schedule: str = "gpipe"
+    # virtual chunks per device for the interleaved 1F1B schedule
+    # (1 = plain; V>1 needs pipe_schedule="1f1b", layers divisible by
+    # pipe*V, and microbatches divisible by pipe). The pipe-sharded
+    # layer stack is applied in interleaved_layer_order.
+    pipe_virtual_stages: int = 1
     # MoE (mixtral-style FFN swap): 0/1 experts = dense
     n_experts: int = 0
     moe_top_k: int = 2
@@ -255,6 +260,15 @@ def _rope_apply(x, cos, sin):
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], -1)
 
 
+def _rope_apply_bhsd(x, cos, sin):
+    """x: [B, H, S, Dh]; rope tables [B, S, Dh/2]."""
+    half = x.shape[-1] // 2
+    c = cos[:, None, :, :].astype(x.dtype)
+    s = sin[:, None, :, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], -1)
+
+
 def _rope(x, positions, theta):
     """x: [B, S, H, Dh]; rotate pairs (single-call convenience)."""
     cos, sin = _rope_tables(positions, x.shape[-1] // 2, theta, x.dtype)
@@ -361,13 +375,34 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
     h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
 
     y = _rms_norm(x, p["attn_norm"], config.norm_eps)
-    q = qdot(y, p["wq"].astype(dtype)).reshape(B, S, h, hd)
-    k = qdot(y, p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
-    v = qdot(y, p["wv"].astype(dtype)).reshape(B, S, kvh, hd)
-    q = _rope_apply(q, rope_cos, rope_sin)
-    k = _rope_apply(k, rope_cos, rope_sin)
-    attn = _attention(config, q, k, v).reshape(B, S, h * hd)
-    x = x + qdot(attn, p["wo"].astype(dtype))
+    if (config.attn_impl == "flash" and not _seq_axis_active()
+            and not fp8_enabled()):
+        # einsum-form projections: q/k/v are produced directly in the
+        # kernel's [B,H,S,Dh] layout and the output projection contracts
+        # (h, k) straight back to [B,S,D] — the layout permutation rides
+        # the matmuls instead of materialising transpose copies.
+        qt = jnp.einsum("bsd,dhk->bhsk", y,
+                        p["wq"].astype(dtype).reshape(D, h, hd))
+        kt = jnp.einsum("bsd,dhk->bhsk", y,
+                        p["wk"].astype(dtype).reshape(D, kvh, hd))
+        vt = jnp.einsum("bsd,dhk->bhsk", y,
+                        p["wv"].astype(dtype).reshape(D, kvh, hd))
+        qt = _rope_apply_bhsd(qt, rope_cos, rope_sin)
+        kt = _rope_apply_bhsd(kt, rope_cos, rope_sin)
+        qt = shard_logical(qt, ("batch", "heads", "seq", "head_dim"))
+        kt = shard_logical(kt, ("batch", "kv_heads", "seq", "head_dim"))
+        vt = shard_logical(vt, ("batch", "kv_heads", "seq", "head_dim"))
+        out = _sharded_flash(config, qt, kt, vt)
+        x = x + jnp.einsum("bhsk,hkd->bsd", out,
+                           p["wo"].astype(dtype).reshape(h, hd, D))
+    else:
+        q = qdot(y, p["wq"].astype(dtype)).reshape(B, S, h, hd)
+        k = qdot(y, p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
+        v = qdot(y, p["wv"].astype(dtype)).reshape(B, S, kvh, hd)
+        q = _rope_apply(q, rope_cos, rope_sin)
+        k = _rope_apply(k, rope_cos, rope_sin)
+        attn = _attention(config, q, k, v).reshape(B, S, h * hd)
+        x = x + qdot(attn, p["wo"].astype(dtype))
     x = shard_logical(x, ("batch", "seq", "embed"))
 
     y = _rms_norm(x, p["mlp_norm"], config.norm_eps)
@@ -485,6 +520,17 @@ def _llama_1f1b_loss(config: LlamaConfig, params, tokens):
         "final_norm": params["final_norm"],
         "lm_head": params["lm_head"],
     }
+    if config.pipe_virtual_stages > 1:
+        from dlrover_tpu.parallel.pipeline import (
+            pipeline_loss_1f1b_interleaved,
+        )
+
+        return pipeline_loss_1f1b_interleaved(
+            _stage_fn(config), last_fn, params["layers"], last_params, x,
+            stage_extras=(cos, sin), last_extras=(labels,),
+            n_microbatches=config.pipe_microbatches,
+            virtual_stages=config.pipe_virtual_stages,
+        )
     return pipeline_loss_1f1b(
         _stage_fn(config), last_fn, params["layers"], last_params, x,
         stage_extras=(cos, sin), last_extras=(labels,),

@@ -107,6 +107,18 @@ def _compiler_params(dims):
         return None
 
 
+def _col(ref):
+    """Load a row-stats block ([..., bq, 8]) as a (bq, 1) column.
+
+    Row statistics (lse, delta) are stored 8 lanes wide: a trailing dim
+    of 1 forces a 1-of-128-lane physical tiling whose XLA-side layout
+    copies cost ~milliseconds per step, while 8 == the array dim is a
+    legal dense-ish Pallas block that matches XLA's natural descending
+    layout (no copies)."""
+    x = ref[...]
+    return x.reshape(x.shape[-2], x.shape[-1])[:, :1]
+
+
 def _t2(ref):
     """Load a block and squeeze the leading unit dims to [rows, cols]."""
     x = ref[...]
@@ -155,6 +167,21 @@ def _tile_meta(nq, nk, block_q, block_k, q_len, kv_len, causal, kv_major):
         ],
         dtype=np.int32,
     )
+
+
+def _needs_p_zero(causal, block_q, block_k, q_len, kv_len):
+    """Whether exp(s_masked) can be nonzero garbage, requiring an explicit
+    p-zeroing select.
+
+    In the aligned causal self-attention case (no padded edge tiles,
+    kv_len >= q_len) every row of every live tile has at least one valid
+    column, so the running max / lse is finite and
+    ``exp(NEG_INF - finite) == 0`` exactly — the select is a wasted VPU
+    pass per masked tile. Padded tiles (or q-longer-than-kv) contain
+    fully-masked rows whose stats are +/-inf or NaN, where 0*NaN would
+    otherwise leak into the contractions."""
+    return (q_len % block_q != 0 or kv_len % block_k != 0
+            or (causal and kv_len < q_len))
 
 
 def _needs_mask_static(causal, block_q, block_k, q_len, kv_len):
@@ -236,7 +263,7 @@ def _io_specs(layout, *, block_q, block_k, head_dim, group):
             lambda b, h, t, m: (b, m[1, t], h // group),
         )
     row_spec = pl.BlockSpec(
-        (1, 1, block_q, 1), lambda b, h, t, m: (b, h, m[0, t], 0)
+        (1, 1, block_q, 8), lambda b, h, t, m: (b, h, m[0, t], 0)
     )
     return q_spec, kv_spec, row_spec
 
@@ -260,7 +287,7 @@ def _kv_out(layout, *, block_k, head_dim):
 def _fwd_kernel(
     meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, block_q, block_k, q_len, kv_len,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
 ):
     t = pl.program_id(2)
     i = meta_ref[0, t]
@@ -294,7 +321,7 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        if mask is not None:
+        if mask is not None and p_zero:
             # explicit zeroing: a fully-masked row has m_new == NEG_INF
             # and exp(s - m_new) == 1 would pollute l
             p = jnp.where(mask, p, 0.0)
@@ -317,11 +344,14 @@ def _fwd_kernel(
         l_safe = jnp.where(l == 0.0, 1.0, l)
         _wr(o_ref, acc_scr[:] / l_safe)
         lse = m_scr[:, :1] + jnp.log(jnp.maximum(l_safe, 1e-30))
-        _wr(lse_ref, lse)
+        _wr(lse_ref, jnp.broadcast_to(lse, (lse.shape[0], 8)))
 
 
 def _fwd(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
          block_k, interpret):
+    if layout == "bshdf":
+        return _fwd_fused(q, k, v, heads, kv_heads, sm_scale, causal,
+                          block_q, block_k, interpret)
     batch, H, KVH, q_len, kv_len, head_dim = _fa_dims(
         layout, q, k, heads, kv_heads)
     group = H // KVH
@@ -336,6 +366,7 @@ def _fwd(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
+        p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
     )
     q_spec, kv_spec, row_spec = _io_specs(
         layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
@@ -356,7 +387,7 @@ def _fwd(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, H, q_len, 1), jnp.float32),
+            jax.ShapeDtypeStruct((batch, H, q_len, 8), jnp.float32),
         ),
         compiler_params=_compiler_params(
             ("parallel", "parallel", "arbitrary")
@@ -367,6 +398,343 @@ def _fwd(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
 
 
 # ---------------------------------------------------------------------------
+# fused-heads kernels (layout "bshdf")
+# ---------------------------------------------------------------------------
+#
+# Grid (batch, packed-tile) with the head loop UNROLLED inside the kernel:
+# every block spans the full H*Dh minor dimension, so all HBM traffic is
+# fully contiguous (no per-head striding, no layout copies), each kv block
+# is fetched once and consumed by every q head, and the causal mask is
+# built once per tile instead of once per head. Per-head softmax stats
+# live in columns of a shared (block_q, 128) scratch. GQA accumulates
+# dk/dv straight into the kv-head columns — no group-sum pass after.
+
+
+def _fwdf_kernel(
+    meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len, heads,
+    kv_heads, p_zero,
+):
+    t = pl.program_id(1)
+    i = meta_ref[0, t]
+    j = meta_ref[1, t]
+    hd = q_ref.shape[-1] // heads
+    group = heads // kv_heads
+
+    @pl.when(meta_ref[2, t] == 1)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _tile(masked):
+        qb = _t2(q_ref) * jnp.asarray(sm_scale, q_ref.dtype)
+        kb = _zero_pad_rows(_t2(k_ref), j, block_k, kv_len)
+        vb = _zero_pad_rows(_t2(v_ref), j, block_k, kv_len)
+        mask = None
+        if masked:
+            mask = _block_mask(
+                (qb.shape[0], kb.shape[0]), i, j, block_q=block_q,
+                block_k=block_k, causal=causal, q_len=q_len, kv_len=kv_len,
+            )
+        for h in range(heads):
+            kvh = h // group
+            q = qb[:, h * hd:(h + 1) * hd]
+            k = kb[:, kvh * hd:(kvh + 1) * hd]
+            v = vb[:, kvh * hd:(kvh + 1) * hd]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_scr[:, h:h + 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            if mask is not None and p_zero:
+                p = jnp.where(mask, p, 0.0)
+            l_new = alpha * l_scr[:, h:h + 1] + jnp.sum(
+                p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[:, h * hd:(h + 1) * hd] = (
+                acc_scr[:, h * hd:(h + 1) * hd] * alpha + pv)
+            m_scr[:, h:h + 1] = m_new
+            l_scr[:, h:h + 1] = l_new
+
+    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
+                   block_k=block_k, q_len=q_len, kv_len=kv_len)
+
+    @pl.when(meta_ref[3, t] == 1)
+    def _final():
+        l = l_scr[:, :heads]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        lse = m_scr[:, :heads] + jnp.log(jnp.maximum(l_safe, 1e-30))
+        # lse block is [1, H, bq, 8]
+        lse_ref[...] = jnp.broadcast_to(
+            lse.T[:, :, None], lse_ref.shape[1:]
+        ).reshape(lse_ref.shape).astype(lse_ref.dtype)
+        parts = [
+            acc_scr[:, h * hd:(h + 1) * hd] / l_safe[:, h:h + 1]
+            for h in range(heads)
+        ]
+        _wr(o_ref, jnp.concatenate(parts, axis=1))
+
+
+def _bwdf_dq_kernel(
+    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    dq_scr,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len, heads,
+    kv_heads, p_zero,
+):
+    t = pl.program_id(1)
+    i = meta_ref[0, t]
+    j = meta_ref[1, t]
+    hd = q_ref.shape[-1] // heads
+    group = heads // kv_heads
+
+    @pl.when(meta_ref[2, t] == 1)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _tile(masked):
+        qb = _t2(q_ref) * jnp.asarray(sm_scale, q_ref.dtype)
+        kb = _zero_pad_rows(_t2(k_ref), j, block_k, kv_len)
+        vb = _zero_pad_rows(_t2(v_ref), j, block_k, kv_len)
+        dob = _t2(do_ref)
+        lse_all = lse_ref[...].reshape(heads, block_q, 8)[..., 0].T  # [bq,H]
+        delta_all = delta_ref[...].reshape(heads, block_q, 8)[..., 0].T
+        mask = None
+        if masked:
+            mask = _block_mask(
+                (qb.shape[0], kb.shape[0]), i, j, block_q=block_q,
+                block_k=block_k, causal=causal, q_len=q_len, kv_len=kv_len,
+            )
+        for h in range(heads):
+            kvh = h // group
+            q = qb[:, h * hd:(h + 1) * hd]
+            k = kb[:, kvh * hd:(kvh + 1) * hd]
+            v = vb[:, kvh * hd:(kvh + 1) * hd]
+            do = dob[:, h * hd:(h + 1) * hd]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+            p = jnp.exp(s - lse_all[:, h:h + 1])
+            if mask is not None and p_zero:
+                p = jnp.where(mask, p, 0.0)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_all[:, h:h + 1])
+            dq_scr[:, h * hd:(h + 1) * hd] += jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
+                   block_k=block_k, q_len=q_len, kv_len=kv_len)
+
+    @pl.when(meta_ref[3, t] == 1)
+    def _final():
+        _wr(dq_ref, dq_scr[:] * sm_scale)
+
+
+def _bwdf_dkv_kernel(
+    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len, heads,
+    kv_heads, p_zero,
+):
+    t = pl.program_id(1)
+    i = meta_ref[0, t]
+    j = meta_ref[1, t]
+    hd = q_ref.shape[-1] // heads
+    group = heads // kv_heads
+
+    @pl.when(meta_ref[2, t] == 1)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _tile(masked):
+        qb = _zero_pad_rows(_t2(q_ref), i, block_q, q_len)
+        qb = qb * jnp.asarray(sm_scale, qb.dtype)
+        kb = _t2(k_ref)
+        vb = _t2(v_ref)
+        dob = _zero_pad_rows(_t2(do_ref), i, block_q, q_len)
+        lse_all = lse_ref[...].reshape(heads, block_q, 8)[..., 0].T  # [bq,H]
+        delta_all = delta_ref[...].reshape(heads, block_q, 8)[..., 0].T
+        delta_all = _zero_pad_rows(delta_all, i, block_q, q_len)
+        mask = None
+        if masked:
+            mask = _block_mask(
+                (qb.shape[0], kb.shape[0]), i, j, block_q=block_q,
+                block_k=block_k, causal=causal, q_len=q_len, kv_len=kv_len,
+            )
+        for h in range(heads):
+            kvh = h // group
+            q = qb[:, h * hd:(h + 1) * hd]
+            k = kb[:, kvh * hd:(kvh + 1) * hd]
+            v = vb[:, kvh * hd:(kvh + 1) * hd]
+            do = dob[:, h * hd:(h + 1) * hd]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+            p = jnp.exp(s - lse_all[:, h:h + 1])
+            if mask is not None and p_zero:
+                p = jnp.where(mask, p, 0.0)
+            dv_scr[:, kvh * hd:(kvh + 1) * hd] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_all[:, h:h + 1])
+            dk_scr[:, kvh * hd:(kvh + 1) * hd] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
+                   block_k=block_k, q_len=q_len, kv_len=kv_len)
+
+    @pl.when(meta_ref[3, t] == 1)
+    def _final():
+        _wr(dk_ref, dk_scr[:])
+        _wr(dv_ref, dv_scr[:])
+
+
+def _fwd_fused(q, k, v, heads, kv_heads, sm_scale, causal, block_q,
+               block_k, interpret):
+    batch, q_len, qd = q.shape
+    kv_len = k.shape[1]
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, kv_len)
+    nq = pl.cdiv(q_len, block_q)
+    nk = pl.cdiv(kv_len, block_k)
+    meta = jnp.asarray(_tile_meta(
+        nq, nk, block_q, block_k, q_len, kv_len, causal, False))
+
+    q_spec = pl.BlockSpec((1, block_q, qd), lambda b, t, m: (b, m[0, t], 0))
+    kv_spec = pl.BlockSpec(
+        (1, block_k, k.shape[2]), lambda b, t, m: (b, m[1, t], 0))
+    lse_spec = pl.BlockSpec(
+        (1, heads, block_q, 8), lambda b, t, m: (b, 0, m[0, t], 0))
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwdf_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
+            heads=heads, kv_heads=kv_heads,
+            p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, meta.shape[1]),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=(q_spec, lse_spec),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, qd), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, q_len, 8), jnp.float32),
+        ),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        interpret=interpret,
+    )(meta, q, k, v)
+    return o, lse
+
+
+def _bwd_fused(heads, kv_heads, sm_scale, causal, block_q, block_k,
+               interpret, res, do):
+    q, k, v, o, lse = res
+    batch, q_len, qd = q.shape
+    kv_len, kvd = k.shape[1], k.shape[2]
+    head_dim = qd // heads
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, kv_len)
+    nq = pl.cdiv(q_len, block_q)
+    nk = pl.cdiv(kv_len, block_k)
+
+    dof = do.astype(jnp.float32) * o.astype(jnp.float32)
+    delta = dof.reshape(batch, q_len, heads, head_dim).sum(-1)
+    delta = jnp.broadcast_to(
+        delta.transpose(0, 2, 1)[..., None],
+        (batch, heads, q_len, 8))
+
+    q_spec = pl.BlockSpec((1, block_q, qd), lambda b, t, m: (b, m[0, t], 0))
+    kv_spec = pl.BlockSpec((1, block_k, kvd), lambda b, t, m: (b, m[1, t], 0))
+    row_spec = pl.BlockSpec(
+        (1, heads, block_q, 8), lambda b, t, m: (b, 0, m[0, t], 0))
+
+    meta_q = jnp.asarray(_tile_meta(
+        nq, nk, block_q, block_k, q_len, kv_len, causal, False))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwdf_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
+            heads=heads, kv_heads=kv_heads,
+            p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, meta_q.shape[1]),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block_q, qd), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        interpret=interpret,
+    )(meta_q, q, k, v, do, lse, delta)
+
+    meta_kv = jnp.asarray(_tile_meta(
+        nq, nk, block_q, block_k, q_len, kv_len, causal, True))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwdf_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
+            heads=heads, kv_heads=kv_heads,
+            p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, meta_kv.shape[1]),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=(kv_spec, kv_spec),
+            scratch_shapes=[
+                pltpu.VMEM((block_k, kvd), jnp.float32),
+                pltpu.VMEM((block_k, kvd), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        interpret=interpret,
+    )(meta_kv, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
 
@@ -374,7 +742,7 @@ def _fwd(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
 def _bwd_dq_kernel(
     meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_scr,
-    *, sm_scale, causal, block_q, block_k, q_len, kv_len,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
 ):
     t = pl.program_id(2)
     i = meta_ref[0, t]
@@ -391,8 +759,8 @@ def _bwd_dq_kernel(
         k = _zero_pad_rows(_t2(k_ref), j, block_k, kv_len)
         v = _zero_pad_rows(_t2(v_ref), j, block_k, kv_len)
         do = _t2(do_ref)
-        lse = _t2(lse_ref)
-        delta = _t2(delta_ref)
+        lse = _col(lse_ref)
+        delta = _col(delta_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -406,7 +774,7 @@ def _bwd_dq_kernel(
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
-        if mask is not None:
+        if mask is not None and p_zero:
             p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -430,7 +798,7 @@ def _bwd_dkv_kernel(
     meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, sm_scale, causal, block_q, block_k, q_len, kv_len,
+    *, sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
 ):
     t = pl.program_id(2)
     i = meta_ref[0, t]
@@ -449,8 +817,8 @@ def _bwd_dkv_kernel(
         k = _t2(k_ref)
         v = _t2(v_ref)
         do = _zero_pad_rows(_t2(do_ref), i, block_q, q_len)
-        lse = _t2(lse_ref)
-        delta = _zero_pad_rows(_t2(delta_ref), i, block_q, q_len)
+        lse = _col(lse_ref)
+        delta = _zero_pad_rows(_col(delta_ref), i, block_q, q_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -464,7 +832,7 @@ def _bwd_dkv_kernel(
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
-        if mask is not None:
+        if mask is not None and p_zero:
             p = jnp.where(mask, p, 0.0)
         # dv += p^T do
         dv_scr[:] += jax.lax.dot_general(
@@ -493,6 +861,9 @@ def _bwd_dkv_kernel(
 
 def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
          interpret, res, do):
+    if layout == "bshdf":
+        return _bwd_fused(heads, kv_heads, sm_scale, causal, block_q,
+                          block_k, interpret, res, do)
     q, k, v, o, lse = res
     batch, H, KVH, q_len, kv_len, head_dim = _fa_dims(
         layout, q, k, heads, kv_heads)
@@ -509,6 +880,7 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
     else:
         delta = dof.reshape(batch, q_len, H, head_dim).sum(-1)
         delta = delta.transpose(0, 2, 1)[..., None]
+    delta = jnp.broadcast_to(delta, delta.shape[:-1] + (8,))
 
     q_spec, kv_spec, row_spec = _io_specs(
         layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
@@ -520,6 +892,7 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
+            p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -548,6 +921,7 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
+            p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -689,15 +1063,26 @@ def flash_attention_bshd(
     bwd_block_q: int | None = None,
     bwd_block_k: int | None = None,
     interpret: bool | None = None,
+    fused: bool = True,
 ):
     """Flash attention on the model-native [B, S, H, Dh] layout.
 
     No transposes on either side: internally the heads fold into the
     minor dimension ([B, S, H*Dh], a free bitcast of the projection
-    output) and each head is read as a tile-aligned 128-lane column
-    block. Requires head_dim % 128 == 0 on hardware (lane-tile
-    alignment); other head dims transparently fall back to the
-    transposing [B,H,S,Dh] path.
+    output). Two kernel families:
+
+    - ``fused=True`` (default): blocks span the full H*Dh minor dim and
+      the head loop is unrolled inside the kernel — all HBM traffic is
+      contiguous, each kv block feeds every q head, mask built once per
+      tile. VMEM scales with H*Dh; the 512-row default blocks fit a
+      2048-wide minor dim comfortably.
+    - ``fused=False``: per-head grid; each head is a tile-aligned
+      128-lane column block (strided HBM reads — mainly an ablation
+      reference).
+
+    Requires head_dim % 128 == 0 on hardware (lane-tile alignment);
+    other head dims transparently fall back to the transposing
+    [B,H,S,Dh] path.
 
     Args:
       q: [batch, q_len, heads, head_dim]
@@ -708,6 +1093,10 @@ def flash_attention_bshd(
     KVH, Skv = k.shape[2], k.shape[1]
     if H % KVH != 0:
         raise ValueError(f"q heads {H} not divisible by kv {KVH}")
+    if H > 128:
+        # the fused kernels keep per-head softmax stats in columns of a
+        # (block_q, 128) scratch; wider models use the per-head grid
+        fused = False
     if sm_scale is None:
         sm_scale = hd ** -0.5
     if interpret is None:
@@ -722,7 +1111,8 @@ def flash_attention_bshd(
         return o.transpose(0, 2, 1, 3)
     o3 = _flash(
         q.reshape(B, S, H * hd), k.reshape(B, Skv, KVH * hd),
-        v.reshape(B, Skv, KVH * hd), "bshd", int(H), int(KVH),
+        v.reshape(B, Skv, KVH * hd), "bshdf" if fused else "bshd",
+        int(H), int(KVH),
         float(sm_scale), bool(causal), int(block_q), int(block_k),
         int(bwd_block_q or block_q), int(bwd_block_k or block_k),
         bool(interpret))

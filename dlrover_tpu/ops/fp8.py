@@ -39,6 +39,11 @@ class _Flag:
     enabled = False
 
 
+def fp8_enabled() -> bool:
+    """Whether :func:`fp8_autocast` is active (trace-time)."""
+    return _Flag.enabled
+
+
 @contextlib.contextmanager
 def fp8_autocast(enabled: bool = True):
     """Trace-time switch: ``qdot`` quantizes while this is active."""

@@ -105,6 +105,7 @@ def estimate_hbm_per_device(
     batch_per_device: int = 8,
     seq_len: int = 2048,
     hidden: int | None = None,
+    attn_quadratic: bool = False,
 ) -> float:
     """Rough bytes/device: params + grads + Adam state + activations.
 
@@ -112,6 +113,16 @@ def estimate_hbm_per_device(
     activations by data×fsdp×seq with remat discounts. ``hidden``
     defaults to the width inferred by :func:`analyse_params` so the
     activation term tracks the actual model instead of a fixed 4096.
+
+    The activation term charges the tensors the backward actually
+    stores per layer — attention q/k/v/o (4 x hidden wide), the MLP
+    gate/up hidden (~3 x hidden each) and the two norm inputs — not a
+    single hidden-wide tensor per layer; at long context the attention
+    residuals dominate and a single-tensor estimate green-lights
+    infeasible meshes that then burn a full compile in the dry-runner.
+    ``attn_quadratic=True`` additionally charges the [B, H, S, S] score
+    materialisation of non-blockwise attention (the reference-einsum
+    path; the Pallas flash kernels keep scores in VMEM tiles).
     """
     if hidden is None:
         hidden = analysis.hidden or 4096
@@ -123,12 +134,23 @@ def estimate_hbm_per_device(
         strategy.remat, 0.35
     )
     act_shard = max(m.seq, 1)
+    # stored per layer (bf16): residual + 2 norm inputs (3x hidden),
+    # q/k/v/o (4x hidden), gate/up hidden (~2 x 3x hidden) + lse rows
+    width_factor = 3.0 + 4.0 + 6.0
     acts = (
-        batch_per_device * seq_len * hidden * 2.0  # bf16 activations
+        batch_per_device * seq_len * hidden * 2.0 * width_factor
         * max(analysis.n_layers, 1)
         * act_discount
         / act_shard
     )
+    if attn_quadratic:
+        heads = max(hidden // 128, 1)
+        # fp32 scores per layer, both operands sequence-sharded (ring
+        # attention holds S_local x S_local blocks per step)
+        acts += (
+            batch_per_device * heads * (seq_len / act_shard) ** 2 * 4.0
+            * max(analysis.n_layers, 1) * act_discount
+        )
     return model_state + acts
 
 

@@ -28,6 +28,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.mesh import get_mesh
@@ -43,6 +44,27 @@ def pipe_size() -> int:
         return get_mesh().shape.get(AXIS, 1)
     except RuntimeError:
         return 1
+
+
+def _gated(pred, true_fn, false_fn, operand):
+    """Branch that is divergent ACROSS pipe stages, uniform within
+    every fsdp/tensor collective group.
+
+    On TPU this is a real ``lax.cond`` — collectives execute in program
+    order, the untaken branch's FLOPs are skipped (the whole point: the
+    head/loss vjp only costs where it runs). XLA:CPU's thunk-executor
+    collective rendezvous deadlocks when different devices run
+    different thunk streams (observed on pipe x tensor meshes even with
+    collective-free branches), so there both branches are computed and
+    ``where``-selected — the uniform-computation behaviour the CPU test
+    mesh requires, at the old every-stage cost."""
+    if jax.default_backend() != "tpu":
+        tv = true_fn(operand)
+        fv = false_fn(operand)
+        return jax.tree.map(
+            lambda a, b: jnp.where(pred, a, b), tv, fv
+        )
+    return jax.lax.cond(pred, true_fn, false_fn, operand)
 
 
 def pipeline_apply(
@@ -236,8 +258,26 @@ def pipeline_loss_1f1b(
     mesh = mesh if mesh is not None else get_mesh()
     S = mesh.shape.get(AXIS, 1)
     if S == 1:
-        h, aux = stage_fn(stage_params, x, *stage_extras)
-        return last_fn(last_params, h, *last_extras) + aux
+        # Honour the per-microbatch last_fn contract (it may scale by
+        # M/valid_total): run it per microbatch and average, exactly as
+        # the eval primal below does.
+        M1 = int(n_microbatches) if n_microbatches else 1
+        if M1 <= 1 or x.shape[0] % M1:
+            h, aux = stage_fn(stage_params, x, *stage_extras)
+            return last_fn(last_params, h, *last_extras) + aux
+        xm = x.reshape((M1, x.shape[0] // M1) + x.shape[1:])
+        sxm = tuple(
+            a.reshape((M1, a.shape[0] // M1) + a.shape[1:])
+            for a in stage_extras)
+        lxm = tuple(
+            a.reshape((M1, a.shape[0] // M1) + a.shape[1:])
+            for a in last_extras)
+        total = 0.0
+        for m in range(M1):
+            h, aux = stage_fn(stage_params, xm[m], *(e[m] for e in sxm))
+            total = total + last_fn(
+                last_params, h, *(e[m] for e in lxm)) + aux
+        return total / M1
 
     M = int(n_microbatches) if n_microbatches else 2 * S
     B = x.shape[0]
@@ -335,19 +375,44 @@ def pipeline_loss_1f1b(
             (h_v, aux_v), stage_vjp = jax.vjp(
                 stage_at_v, params_t, cur_v
             )
-            # head/loss vjp runs on every stage for uniformity; only the
-            # last stage's contribution is kept (the per-stage overhead
-            # matches the recompute GPipe-with-remat pays anyway)
-            ce, ce_vjp = jax.vjp(
-                lambda lp_, h_: last_fn(lp_, h_, *lx_v),
-                last_params_t, h_v,
+            # Head/loss vjp only where it matters. The branch predicate
+            # (is_last) is uniform within every fsdp/tensor collective
+            # group (those axes live inside one pipe stage), and
+            # last_params are pre-replicated before the schedule, so the
+            # taken branch contains no GSPMD resharding collectives —
+            # the divergent-collectives deadlock that forces the
+            # stage_fn vjp to stay uniform does not apply here.
+            def _head(op):
+                lp_, h_ = op
+                ce_, ce_vjp = jax.vjp(
+                    lambda l, h: last_fn(l, h, *lx_v), lp_, h_
+                )
+                d_lp_, d_h_ = ce_vjp(jnp.ones((), ce_.dtype))
+                return (jnp.float32(ce_), d_lp_,
+                        d_h_.astype(jnp.float32))
+
+            def _head_zero(op):
+                lp_, h_ = op
+                return (jnp.float32(0.0),
+                        jax.tree.map(jnp.zeros_like, lp_),
+                        jnp.zeros(h_.shape, jnp.float32))
+
+            ce, d_lp, d_h_ce = _gated(
+                is_last, _head, _head_zero, (last_params_t, h_v)
             )
-            d_lp, d_h_ce = ce_vjp(jnp.ones((), ce.dtype))
-            seed_h = jnp.where(
-                is_last, d_h_ce.astype(jnp.float32), bwd_msg
-            ).astype(h_v.dtype)
+            seed_h = jnp.where(is_last, d_h_ce, bwd_msg).astype(
+                h_v.dtype)
             d_p, d_c = stage_vjp((seed_h, jnp.ones((), aux_v.dtype)))
-            out_chain, _aux_f = stage_fn(params_t, cur, *sx_f)
+            # On the last stage the vjp primal IS fwd(cur) (its vjp
+            # microbatch equals its fwd microbatch): _gated skips the
+            # duplicate chain forward on TPU and balances tick cost
+            # (last = vjp + head, others = vjp + chain fwd).
+            out_chain = _gated(
+                is_last,
+                lambda _: h_v,
+                lambda _: stage_fn(params_t, cur, *sx_f)[0],
+                None,
+            )
 
             d_c = jnp.where(valid_v, d_c, 0).astype(jnp.float32)
             d_params = jax.tree.map(
@@ -421,6 +486,18 @@ def pipeline_loss_1f1b(
         return loss, d_params, d_last, d_x
 
     def run_schedule(sp, lp, x_, sx, lx):
+        # Replicate the head params ONCE before the schedule: their
+        # per-tick use inside the scan then needs no GSPMD all-gather,
+        # which (a) keeps the cond-gated head vjp free of collectives
+        # and (b) hoists a loop-invariant gather out of the scan.
+        from jax.sharding import NamedSharding
+
+        lp = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P())
+            ),
+            lp,
+        )
         return get_shard_map()(
             schedule,
             mesh=mesh,
@@ -442,8 +519,6 @@ def pipeline_loss_1f1b(
         )(sp, lp, x_, sx, lx)
 
     def _zero_cotangent(a):
-        import numpy as np
-
         if jnp.issubdtype(a.dtype, jnp.inexact):
             return jnp.zeros_like(a)
         return np.zeros(a.shape, jax.dtypes.float0)
@@ -487,6 +562,537 @@ def pipeline_loss_1f1b(
 
     _loss.defvjp(_loss_fwd, _loss_bwd)
     return _loss(stage_params, last_params, x_mb, sx_mb, lx_mb)
+
+
+def interleaved_layer_order(L: int, S: int, V: int):
+    """Stacked-row order the interleaved schedule applies layers in.
+
+    Under ``virtual_stages=V`` the pipe-sharded stack [L, ...] is
+    interpreted chunk-major per device: effective position
+    ``e = vs*Lc + i`` (virtual stage ``vs = v*S + s``) maps to stacked
+    row ``s*(L/S) + v*Lc + i``. A dense model equals the interleaved
+    one when its layers are permuted with this order (useful for parity
+    tests and for importing externally-ordered weights)."""
+    Lc = L // (S * V)
+    order = []
+    for vs in range(S * V):
+        s, v = vs % S, vs // S
+        for i in range(Lc):
+            order.append(s * (L // S) + v * Lc + i)
+    return np.asarray(order, dtype=np.int64)
+
+
+def pipeline_loss_1f1b_interleaved(
+    stage_fn: Callable,
+    last_fn: Callable,
+    stage_params,
+    last_params,
+    x,
+    stage_extras=(),
+    last_extras=(),
+    n_microbatches: int = 0,
+    virtual_stages: int = 2,
+    mesh=None,
+):
+    """Interleaved (virtual-stage) 1F1B: each device runs V
+    non-contiguous layer chunks (reference default schedule,
+    pipeline_parallel_optimization.py:98 Interleaved1F1B), cutting the
+    pipeline bubble by ~V versus plain 1F1B.
+
+    TPU redesign: the whole schedule stays ONE ``lax.scan`` under
+    ``shard_map``; a trace-time event simulation
+    (:func:`_interleaved_tables`) precomputes per-(tick, device) unit
+    tables and message-routing tables that ride into the kernel as
+    int32 constants, so every tick runs the SAME program (one chain
+    forward + one stage vjp, ``where``-indexed) — no divergent
+    collectives. Activation messages ride a full ``ppermute`` ring
+    (wrap edge S-1 -> 0 carries chunk transitions); the per-chunk input
+    ring buffer doubles as the fwd-message mailbox.
+
+    The local layer stack [L/S, ...] is interpreted as [V, L/(S*V)]
+    chunk-major; see :func:`interleaved_layer_order` for the effective
+    layer order.
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    S = mesh.shape.get(AXIS, 1)
+    V = int(virtual_stages)
+    if S == 1 or V <= 1:
+        return pipeline_loss_1f1b(
+            stage_fn, last_fn, stage_params, last_params, x,
+            stage_extras=stage_extras, last_extras=last_extras,
+            n_microbatches=n_microbatches, mesh=mesh,
+        )
+    M = int(n_microbatches) if n_microbatches else 2 * S
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    L_local = jax.tree.leaves(stage_params)[0].shape[0] // S
+    if L_local % V:
+        raise ValueError(
+            f"local layer count {L_local} not divisible by "
+            f"virtual_stages {V}"
+        )
+    tables_np, T, R = _interleaved_tables(S, V, M)
+
+    def to_micro(a):
+        return a.reshape((M, a.shape[0] // M) + a.shape[1:])
+
+    x_mb = to_micro(x)
+    sx_mb = tuple(to_micro(a) for a in stage_extras)
+    lx_mb = tuple(to_micro(a) for a in last_extras)
+
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.parallel import get_shard_map
+
+    # [8, T, S]: fm fv bm bv rfm rfv rbm rbv
+    keys = ("fm", "fv", "bm", "bv", "rfm", "rfv", "rbm", "rbv")
+    tab_all = jnp.asarray(
+        np.stack([tables_np[k] for k in keys], axis=0)
+    )
+
+    def _idx(a, i):
+        return jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+
+    def _chunk(tree, v):
+        """Select chunk v of the local [L/S, ...] stack (-> [Lc, ...])."""
+        def sel(a):
+            lc = a.shape[0] // V
+            return jax.lax.dynamic_index_in_dim(
+                a.reshape((V, lc) + a.shape[1:]), v, 0, keepdims=False
+            )
+        return jax.tree.map(sel, tree)
+
+    def _chunk_add(tree, v, grads, valid):
+        def add(acc, g):
+            lc = acc.shape[0] // V
+            stacked = acc.reshape((V, lc) + acc.shape[1:])
+            stacked = stacked.at[v].add(
+                jnp.where(valid, g, 0).astype(stacked.dtype)
+            )
+            return stacked.reshape(acc.shape)
+        return jax.tree.map(add, tree, grads)
+
+    def schedule(params_local, last_params_, x_mb_, sx_mb_, lx_mb_):
+        stage = jax.lax.axis_index(AXIS)
+        is_last = stage == S - 1
+        mb_shape = x_mb_.shape[1:]
+
+        def f32_zeros_like(tree):
+            return jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), tree
+            )
+
+        carry0 = (
+            jnp.zeros(mb_shape, x_mb_.dtype),              # fwd_msg
+            jnp.zeros(mb_shape, jnp.float32),              # bwd_msg
+            jnp.zeros((V, R) + mb_shape, x_mb_.dtype),     # inbuf
+            jnp.zeros((V, R) + mb_shape, jnp.float32),     # cotbuf
+            f32_zeros_like(params_local),                  # d_params
+            f32_zeros_like(last_params_),                  # d_last
+            jnp.zeros(x_mb_.shape, jnp.float32),           # d_x
+            jnp.zeros((), jnp.float32),                    # ce_acc
+            jnp.zeros((), jnp.float32),                    # aux_acc
+        )
+
+        def _buf_get(buf, v, m):
+            return _idx(_idx(buf, v), jnp.mod(m, R))
+
+        def _buf_set(buf, v, m, val, gate):
+            upd = jax.lax.dynamic_update_index_in_dim(
+                _idx(buf, v), val.astype(buf.dtype), jnp.mod(m, R), 0
+            )
+            upd = jax.lax.dynamic_update_index_in_dim(buf, upd, v, 0)
+            return jnp.where(gate, upd, buf)
+
+        def tick(carry, t):
+            (fwd_msg, bwd_msg, inbuf, cotbuf, d_params, d_last, d_x,
+             ce_acc, aux_acc) = carry
+            (params_t, last_params_t), fwd_msg = (
+                jax.lax.optimization_barrier(
+                    ((params_local, last_params_), fwd_msg)
+                )
+            )
+            vals = tab_all[:, t, :]
+            (fm, fv, bm, bv, rfm, rfv, rbm, rbv) = tuple(
+                _idx(vals[i], stage) for i in range(8)
+            )
+            valid_f = fm >= 0
+            valid_b = bm >= 0
+            fmi = jnp.clip(fm, 0, M - 1)
+            fvi = jnp.clip(fv, 0, V - 1)
+            bmi = jnp.clip(bm, 0, M - 1)
+            bvi = jnp.clip(bv, 0, V - 1)
+
+            # 1) deliver incoming messages into the mailboxes
+            inbuf = _buf_set(
+                inbuf, jnp.clip(rfv, 0, V - 1),
+                jnp.clip(rfm, 0, M - 1), fwd_msg, rfm >= 0,
+            )
+            cotbuf = _buf_set(
+                cotbuf, jnp.clip(rbv, 0, V - 1),
+                jnp.clip(rbm, 0, M - 1), bwd_msg, rbm >= 0,
+            )
+
+            # 2) forward unit: input = injection (stage 0 chunk 0) or
+            # the mailbox; store it as the saved input for the vjp
+            inject = _idx(x_mb_, fmi)
+            cur = jnp.where(
+                (stage == 0) & (fvi == 0), inject,
+                _buf_get(inbuf, fvi, fmi),
+            )
+            inbuf = _buf_set(inbuf, fvi, fmi, cur, valid_f)
+            sx_f = tuple(_idx(e, fmi) for e in sx_mb_)
+            params_f = _chunk(params_t, fvi)
+
+            # 3) vjp unit at its saved input
+            saved = _buf_get(inbuf, bvi, bmi)
+            sx_v = tuple(_idx(e, bmi) for e in sx_mb_)
+            lx_v = tuple(_idx(e, bmi) for e in lx_mb_)
+            params_b = _chunk(params_t, bvi)
+
+            (h_v, aux_v), stage_vjp = jax.vjp(
+                lambda p_, c_: stage_fn(p_, c_, *sx_v), params_b, saved
+            )
+            lastv_b = is_last & (bvi == V - 1)
+
+            def _head(op):
+                lp_, h_ = op
+                ce_, ce_vjp = jax.vjp(
+                    lambda l, h: last_fn(l, h, *lx_v), lp_, h_
+                )
+                d_lp_, d_h_ = ce_vjp(jnp.ones((), ce_.dtype))
+                return (jnp.float32(ce_), d_lp_,
+                        d_h_.astype(jnp.float32))
+
+            def _head_zero(op):
+                lp_, h_ = op
+                return (jnp.float32(0.0),
+                        jax.tree.map(jnp.zeros_like, lp_),
+                        jnp.zeros(h_.shape, jnp.float32))
+
+            ce, d_lp, d_h_ce = _gated(
+                lastv_b, _head, _head_zero, (last_params_t, h_v)
+            )
+            seed_h = jnp.where(
+                lastv_b, d_h_ce, _buf_get(cotbuf, bvi, bmi)
+            ).astype(h_v.dtype)
+            d_p, d_c = stage_vjp((seed_h, jnp.ones((), aux_v.dtype)))
+            # chain fwd; on the fused last-virtual tick the vjp primal
+            # IS fwd(cur) (tables guarantee (fm,fv)==(bm,bv) there)
+            lastv_f = is_last & (fvi == V - 1)
+            out_chain = _gated(
+                lastv_f,
+                lambda _: h_v,
+                lambda _: stage_fn(params_f, cur, *sx_f)[0],
+                None,
+            )
+
+            d_c = jnp.where(valid_b, d_c, 0).astype(jnp.float32)
+            d_params = _chunk_add(d_params, bvi, d_p, valid_b)
+            d_last = jax.tree.map(
+                lambda acc, g: acc + jnp.where(
+                    lastv_b & valid_b, g, 0
+                ).astype(jnp.float32),
+                d_last, d_lp,
+            )
+            ce_acc = ce_acc + jnp.where(
+                lastv_b & valid_b, ce, 0.0
+            ).astype(jnp.float32)
+            aux_acc = aux_acc + jnp.where(valid_b, aux_v, 0.0).astype(
+                jnp.float32
+            )
+            d_x = jnp.where(
+                valid_b & (stage == 0) & (bvi == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    d_x, d_c, bmi, 0
+                ),
+                d_x,
+            )
+
+            fwd_msg = jax.lax.ppermute(
+                out_chain, AXIS,
+                [(i, (i + 1) % S) for i in range(S)],
+            )
+            d_c, fwd_msg = jax.lax.optimization_barrier((d_c, fwd_msg))
+            bwd_msg = jax.lax.ppermute(
+                d_c, AXIS, [(i, (i - 1) % S) for i in range(S)]
+            )
+            return (fwd_msg, bwd_msg, inbuf, cotbuf, d_params, d_last,
+                    d_x, ce_acc, aux_acc), None
+
+        (_, _, _, _, d_params, d_last, d_x, ce_acc, aux_acc), _ = (
+            jax.lax.scan(tick, carry0, jnp.arange(T))
+        )
+        reduce_leaves, reduce_def = jax.tree.flatten(
+            (ce_acc, aux_acc, d_last, d_x)
+        )
+        sizes = [leaf.size for leaf in reduce_leaves]
+        flat = jnp.concatenate([leaf.ravel() for leaf in reduce_leaves])
+        flat = jax.lax.psum(flat, AXIS)
+        parts, off = [], 0
+        for leaf, size in zip(reduce_leaves, sizes):
+            parts.append(flat[off:off + size].reshape(leaf.shape))
+            off += size
+        ce_acc, aux_acc, d_last, d_x = jax.tree.unflatten(
+            reduce_def, parts
+        )
+        loss = (ce_acc + aux_acc) / M
+        d_params = jax.tree.map(
+            lambda g, p: (g / M).astype(p.dtype), d_params, params_local
+        )
+        d_last = jax.tree.map(
+            lambda g, p: (g / M).astype(p.dtype), d_last, last_params_
+        )
+        d_x = (d_x / M).astype(x_mb_.dtype)
+        return loss, d_params, d_last, d_x
+
+    def run_schedule(sp, lp, x_, sx, lx):
+        from jax.sharding import NamedSharding
+
+        lp = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P())
+            ),
+            lp,
+        )
+        return get_shard_map()(
+            schedule,
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(lambda _: P(AXIS), sp),
+                jax.tree.map(lambda _: P(), lp),
+                P(),
+                jax.tree.map(lambda _: P(), sx),
+                jax.tree.map(lambda _: P(), lx),
+            ),
+            out_specs=(
+                P(),
+                jax.tree.map(lambda _: P(AXIS), sp),
+                jax.tree.map(lambda _: P(), lp),
+                P(),
+            ),
+            axis_names={AXIS},
+            check_vma=False,
+        )(sp, lp, x_, sx, lx)
+
+    def _eval_primal(sp, lp, x_, sx, lx):
+        """V GPipe ring passes in virtual-stage order (chunk v of every
+        stage before chunk v+1) — the forward the fused schedule's
+        gradients correspond to."""
+        h = x_.reshape((-1,) + x_.shape[2:])
+        sx_flat = tuple(e.reshape((-1,) + e.shape[2:]) for e in sx)
+        aux_total = 0.0
+        for v in range(V):
+            def chunk_v(a, v=v):
+                lc = a.shape[0] // (S * V)
+                return a.reshape((S, V, lc) + a.shape[1:])[:, v].reshape(
+                    (S * lc,) + a.shape[1:]
+                )
+            sp_v = jax.tree.map(chunk_v, sp)
+            h, aux = pipeline_apply(
+                stage_fn, sp_v, h, *sx_flat,
+                n_microbatches=M, mesh=mesh,
+            )
+            aux_total = aux_total + aux
+        h = h.reshape(x_.shape)
+        ce = 0.0
+        for m in range(M):
+            ce = ce + last_fn(lp, h[m], *(e[m] for e in lx))
+        return ce / M + aux_total
+
+    def _zero_cotangent(a):
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return jnp.zeros_like(a)
+        return np.zeros(a.shape, jax.dtypes.float0)
+
+    @jax.custom_vjp
+    def _loss(sp, lp, x_, sx, lx):
+        return _eval_primal(sp, lp, x_, sx, lx)
+
+    def _loss_fwd(sp, lp, x_, sx, lx):
+        out, d_sp, d_lp, d_x = run_schedule(sp, lp, x_, sx, lx)
+        return out, (d_sp, d_lp, d_x, sx, lx)
+
+    def _loss_bwd(res, ct):
+        d_sp, d_lp, d_x, sx, lx = res
+
+        def scale(tree):
+            return jax.tree.map(
+                lambda g: (ct * g.astype(jnp.float32)).astype(g.dtype),
+                tree,
+            )
+
+        return (
+            scale(d_sp),
+            scale(d_lp),
+            scale(d_x),
+            jax.tree.map(_zero_cotangent, sx),
+            jax.tree.map(_zero_cotangent, lx),
+        )
+
+    _loss.defvjp(_loss_fwd, _loss_bwd)
+    return _loss(stage_params, last_params, x_mb, sx_mb, lx_mb)
+
+
+def _interleaved_tables(S: int, V: int, M: int):
+    """Build the interleaved-1F1B tick tables by event simulation.
+
+    Device ``s`` owns chunks ``v*S + s`` (Megatron layout, reference
+    pipeline_parallel_optimization.py:98 Interleaved1F1B). Units follow
+    the standard order (groups of S microbatches per chunk round); the
+    simulation advances tick by tick with 1-tick message latency and
+    the fused last-virtual-stage rule (its bwd runs in the same tick as
+    its fwd — the vjp serves both), recording for every (tick, device):
+
+      fm/fv: fwd unit (microbatch, chunk) or -1 (bubble)
+      bm/bv: bwd unit or -1
+      rfm/rfv: routing of the INCOMING fwd message (what the ring
+               predecessor sent last tick; -1 = ignore)
+      rbm/rbv: routing of the incoming cotangent message
+
+    Returns (tables dict of int32 [T, S] arrays, T, R) where R is the
+    smallest per-chunk ring-buffer depth with no live-slot collision.
+    """
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs microbatches ({M}) divisible by "
+            f"pipe size ({S})"
+        )
+    total = M * V
+
+    def unit(k: int, forward: bool):
+        v = (k // S) % V
+        if not forward:
+            v = V - 1 - v
+        m = (k // (S * V)) * S + k % S
+        return m, v
+
+    warmup = [
+        min(total, (S - s - 1) * 2 + (V - 1) * S) for s in range(S)
+    ]
+
+    # per-device progress
+    fidx = [0] * S
+    bidx = [0] * S
+    # fwd inputs available: (m, v) -> earliest tick usable
+    avail_f = [dict() for _ in range(S)]
+    avail_b = [dict() for _ in range(S)]
+    for m in range(M):
+        avail_f[0][(m, 0)] = 0  # injected from x_mb
+    # in-flight messages: (arrive_tick, dest, kind, m, v)
+    msgs = []
+    rows = {k: [] for k in
+            ("fm", "fv", "bm", "bv", "rfm", "rfv", "rbm", "rbv")}
+    live = [set() for _ in range(S)]    # (m, v) saved inputs in use
+    max_live = [dict() for _ in range(S)]  # v -> peak concurrent m set
+    live_by_chunk = [
+        {v: set() for v in range(V)} for _ in range(S)
+    ]
+    peak = 0
+    t = 0
+    guard = 4 * (total + 2 * S * V) + 64
+    while any(b < total for b in bidx):
+        if t > guard:
+            raise RuntimeError(
+                f"interleaved schedule did not converge "
+                f"(S={S} V={V} M={M})"
+            )
+        row = {k: [-1] * S for k in rows}
+        # deliveries
+        arriving = [m_ for m_ in msgs if m_[0] == t]
+        msgs = [m_ for m_ in msgs if m_[0] != t]
+        for _, dest, kind, m, v in arriving:
+            if kind == "f":
+                row["rfm"][dest], row["rfv"][dest] = m, v
+                avail_f[dest][(m, v)] = t
+            else:
+                row["rbm"][dest], row["rbv"][dest] = m, v
+                avail_b[dest][(m, v)] = t
+        for s in range(S):
+            ran_f = ran_b = None
+            # Each fused tick runs one fwd unit AND one vjp unit. A fwd
+            # runs when its input has arrived AND in-flight microbatch
+            # inputs stay within the warmup bound (the 1F1B memory
+            # cap: runaway stage-0 fwds would degenerate to GPipe
+            # buffering); a bwd runs whenever its cotangent is here.
+            if fidx[s] < total:
+                m, v = unit(fidx[s], True)
+                if avail_f[s].get((m, v), 10 ** 9) <= t and (
+                    fidx[s] - bidx[s] <= warmup[s]
+                ):
+                    ran_f = (m, v)
+            if bidx[s] < total:
+                m, v = unit(bidx[s], False)
+                is_lastv = s == S - 1 and v == V - 1
+                if is_lastv:
+                    # fused: runs in the same tick as its own fwd (the
+                    # one vjp serves both roles, seeded by the head)
+                    if ran_f == (m, v):
+                        ran_b = (m, v)
+                elif avail_b[s].get((m, v), 10 ** 9) <= t:
+                    ran_b = (m, v)
+            if ran_f is not None:
+                m, v = ran_f
+                row["fm"][s], row["fv"][s] = m, v
+                fidx[s] += 1
+                live_by_chunk[s][v].add(m)
+                peak = max(peak, max(
+                    len(x) for x in live_by_chunk[s].values()
+                ))
+                # message to the next virtual stage
+                if not (s == S - 1 and v == V - 1):
+                    dest = (s + 1) % S
+                    nv = v if s < S - 1 else v + 1
+                    msgs.append((t + 1, dest, "f", m, nv))
+            if ran_b is not None:
+                m, v = ran_b
+                row["bm"][s], row["bv"][s] = m, v
+                bidx[s] += 1
+                live_by_chunk[s][v].discard(m)
+                if not (s == 0 and v == 0):
+                    dest = (s - 1) % S
+                    nv = v if s > 0 else v - 1
+                    msgs.append((t + 1, dest, "b", m, nv))
+        for k in rows:
+            rows[k].append(row[k])
+        t += 1
+
+    T = t
+    tables = {
+        k: np.asarray(rows[k], dtype=np.int32) for k in rows
+    }
+    # ring depth: smallest R where concurrently-live microbatches of a
+    # chunk never collide mod R (validated by replay)
+    R = max(peak, 1)
+    while R <= M:
+        ok = True
+        live_slots = [
+            {v: {} for v in range(V)} for _ in range(S)
+        ]
+        for tt in range(T):
+            for s in range(S):
+                bm, bv = tables["bm"][tt][s], tables["bv"][tt][s]
+                if bm >= 0:
+                    live_slots[s][bv].pop(bm % R, None)
+                fm, fv = tables["fm"][tt][s], tables["fv"][tt][s]
+                if fm >= 0:
+                    slot = fm % R
+                    if live_slots[s][fv].get(slot, fm) != fm:
+                        ok = False
+                    live_slots[s][fv][slot] = fm
+                rfm, rfv = tables["rfm"][tt][s], tables["rfv"][tt][s]
+                if rfm >= 0:
+                    slot = rfm % R
+                    if live_slots[s][rfv].get(slot, rfm) != rfm:
+                        ok = False
+                    live_slots[s][rfv][slot] = rfm
+            if not ok:
+                break
+        if ok:
+            break
+        R += 1
+    return tables, T, R
 
 
 def stage_layer_scan(

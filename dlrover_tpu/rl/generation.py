@@ -93,6 +93,39 @@ def _decode_layers(config: LlamaConfig, params, x, positions, cache,
 
     new_pos = cache.pos.at[write_idx].set(positions[0])
 
+    def _moe_mlp(y, p):
+        """Per-token top-k expert dispatch: no capacity machinery —
+        every token computes its selected experts exactly (the
+        training-path capacity dropping only matters at scale).
+        Gating matches parallel/moe.py:top_k_gating: softmax over all
+        experts, top-k of the probs, renormalised over the selection.
+        All E experts run batched and combine through zero weights —
+        exact at E/top_k x the minimal FFN FLOPs, which is noise at
+        decode (S=1) but real on long-prompt prefill; a gathered
+        dispatch for prefill is a known optimisation left undone.
+        Ref capability: atorch/atorch/rl/inference_backend/ serves MoE
+        policies through vLLM."""
+        E, k = config.n_experts, config.moe_top_k
+        logits = jnp.einsum(
+            "bsd,de->bse", y.astype(jnp.float32),
+            p["router"].astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)        # [B,S,k]
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+        # [B,S,E] combine weights (0 for unselected experts)
+        weights = jnp.sum(
+            gate_vals[..., None] * jax.nn.one_hot(gate_idx, E), axis=-2
+        ).astype(dtype)
+        # decode shapes are tiny (S=1): run all experts batched and
+        # zero-combine — one einsum chain on the MXU, no gather/scatter
+        gate_h = jax.nn.silu(jnp.einsum(
+            "bsd,edm->bsem", y, p["w_gate"].astype(dtype)))
+        up_h = jnp.einsum("bsd,edm->bsem", y, p["w_up"].astype(dtype))
+        out = jnp.einsum(
+            "bsem,emd->bsed", gate_h * up_h, p["w_down"].astype(dtype))
+        return jnp.einsum("bse,bsed->bsd", weights, out)
+
     def layer(carry, xs):
         hdn = carry
         p, ck, cv = xs
@@ -109,9 +142,12 @@ def _decode_layers(config: LlamaConfig, params, x, positions, cache,
         ).reshape(B, S, h * hd)
         hdn = hdn + attn @ p["wo"].astype(dtype)
         y = _rms_norm(hdn, p["mlp_norm"], config.norm_eps)
-        gate = jax.nn.silu(y @ p["w_gate"].astype(dtype))
-        up = y @ p["w_up"].astype(dtype)
-        hdn = hdn + (gate * up) @ p["w_down"].astype(dtype)
+        if config.is_moe:
+            hdn = hdn + _moe_mlp(y, p)
+        else:
+            gate = jax.nn.silu(y @ p["w_gate"].astype(dtype))
+            up = y @ p["w_up"].astype(dtype)
+            hdn = hdn + (gate * up) @ p["w_down"].astype(dtype)
         return hdn, (ck, cv)
 
     hidden, (new_k, new_v) = jax.lax.scan(
@@ -126,13 +162,23 @@ def _logits(config: LlamaConfig, params, hidden):
 
 
 def prefill(config: LlamaConfig, params, tokens, cache: KVCache):
-    """Write the prompt's K/V; returns (last-token logits, cache)."""
+    """Write the prompt's K/V; returns (last-token logits, cache).
+
+    A prompt longer than the cache keeps its last C tokens (true
+    sliding-window semantics): writing P > C slots in one scatter would
+    hit duplicate ring indices, whose winner is undefined."""
     dtype = jnp.dtype(config.dtype)
     B, P = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
-    x = params["embed"].astype(dtype)[tokens]
     C = cache.pos.shape[0]
-    write_idx = jnp.arange(P, dtype=jnp.int32) % C
+    start = 0
+    if P > C:
+        start = P - C
+        tokens = tokens[:, -C:]
+        P = C
+    positions = jnp.broadcast_to(
+        jnp.arange(start, start + P, dtype=jnp.int32), (B, P))
+    x = params["embed"].astype(dtype)[tokens]
+    write_idx = jnp.arange(start, start + P, dtype=jnp.int32) % C
     hidden, cache = _decode_layers(
         config, params, x, positions, cache, write_idx
     )
@@ -199,7 +245,11 @@ def generate(
             logp, tok[:, None], axis=-1
         )[:, 0]
 
-    tok0, lp0 = sample(logits, rng)
+    # split before the first sample: reusing ``rng`` both for token 0
+    # and as the scan carry would correlate token 0 with every later
+    # draw (the carry is split from the same key)
+    rng, sub0 = jax.random.split(rng)
+    tok0, lp0 = sample(logits, sub0)
     alive0 = jnp.ones((B,), jnp.float32)
 
     def step(carry, i):
@@ -237,11 +287,6 @@ class KVCacheGenerationBackend:
 
     def __init__(self, config: LlamaConfig,
                  gen: Optional[GenerateConfig] = None):
-        if config.is_moe:
-            raise NotImplementedError(
-                "KV-cache decoding implements the dense MLP only; "
-                "MoE decode (expert dispatch per token) is not wired yet"
-            )
         self.config = config
         self.gen = gen or GenerateConfig()
         self._fn = jax.jit(

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import ssl
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -83,6 +84,7 @@ class RestK8sClient:
                  ca_cert: str | None = None):
         if base_url is None:
             base_url = os.environ.get("DLROVER_TPU_K8S_API", "")
+        explicit_endpoint = bool(base_url)
         self._token_file = None
         if not base_url and os.environ.get("KUBERNETES_SERVICE_HOST"):
             host = os.environ["KUBERNETES_SERVICE_HOST"]
@@ -95,11 +97,16 @@ class RestK8sClient:
             )
         self.base_url = base_url.rstrip("/")
         self.namespace = namespace
-        # Service-account credentials apply to https endpoints however
-        # the endpoint was resolved (an explicit DLROVER_TPU_K8S_API at
-        # a real secured API server needs them too) — but never over
-        # plain http, which would leak the cluster credential.
-        if self.base_url.startswith("https"):
+        # The mounted service-account token is auto-attached ONLY when
+        # the endpoint came from the in-cluster service env — an
+        # arbitrary DLROVER_TPU_K8S_API URL must not silently receive
+        # the cluster credential (an attacker-controlled env var would
+        # exfiltrate it). Explicit endpoints pass ``token=`` or opt in
+        # via DLROVER_TPU_K8S_SA_TOKEN=1; plain http never gets it.
+        sa_opt_in = os.environ.get("DLROVER_TPU_K8S_SA_TOKEN") == "1"
+        if self.base_url.startswith("https") and (
+            not explicit_endpoint or sa_opt_in
+        ):
             token_file = os.path.join(_SA_DIR, "token")
             if token is None and os.path.exists(token_file):
                 # bound SA tokens rotate on disk (kubelet) — remember
@@ -141,6 +148,40 @@ class RestK8sClient:
 
     def _pods_path(self) -> str:
         return f"/api/v1/namespaces/{self.namespace}/pods"
+
+    def _crd_path(self, plural: str) -> str:
+        from dlrover_tpu.scheduler.crd import GROUP, VERSION
+
+        return (
+            f"/apis/{GROUP}/{VERSION}/namespaces/{self.namespace}/{plural}"
+        )
+
+    # ------------------------------------------- custom-resource verbs
+
+    def list_custom_resources(self, plural: str, label_selector: str = ""):
+        """List namespaced CRs (e.g. ``scaleplans``) as raw manifests."""
+        query = {"labelSelector": label_selector} if label_selector else None
+        with self._request(
+            "GET", self._crd_path(plural), query=query
+        ) as resp:
+            return json.loads(resp.read().decode()).get("items", [])
+
+    def create_custom_resource(self, plural: str, manifest: dict) -> bool:
+        with self._request("POST", self._crd_path(plural), body=manifest):
+            pass
+        return True
+
+    def delete_custom_resource(self, plural: str, name: str) -> bool:
+        try:
+            with self._request(
+                "DELETE", f"{self._crd_path(plural)}/{name}"
+            ):
+                pass
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
 
     # -------------------------------------------------------- pod verbs
 

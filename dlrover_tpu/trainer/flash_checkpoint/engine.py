@@ -482,8 +482,49 @@ class CheckpointEngine:
         names = _translate_legacy_names(
             sorted({l.path for l in meta.leaves})
         )
-        leaf_map: dict[str, list[tuple[LeafMeta, np.ndarray]]] = {}
+        piece_map: dict[str, list] = {}
         for leaf in meta.leaves:
+            piece_map.setdefault(names[leaf.path], []).append(
+                (leaf, buf, None)
+            )
+        meta_view = {
+            k: [(m, None) for m, _, _ in v] for k, v in piece_map.items()
+        }
+        if target is not None:
+            # This host's shm may legitimately hold only a subset of the
+            # leaves (sharded engine dedups host-replicated leaves to one
+            # writer) — an incomplete shm restore must fall back to
+            # storage rather than silently keep freshly-init leaves.
+            tnames, _, _ = _tree_flatten_with_names(target)
+            if any(name not in piece_map for name in tnames):
+                logger.info(
+                    "shm checkpoint incomplete for this host; falling "
+                    "back to storage"
+                )
+                return None
+        if not _covers_global(meta_view):
+            logger.info(
+                "shm shards do not cover the global arrays (multi-host "
+                "state); falling back to storage"
+            )
+            return None
+        if target is not None:
+            # shard-wise fill straight from shm views: a target shard
+            # copies only its intersecting boxes (peak host memory ~one
+            # shard; the full-global assemble would double the state's
+            # host footprint at 7B scale)
+            result = self._fill_from_pieces(
+                piece_map, target, meta.step, _shm_read_box
+            )
+            logger.info(
+                "restored step %s from shared memory (shard-wise)",
+                meta.step,
+            )
+            return result
+        leaf_map: dict[str, list[tuple[LeafMeta, np.ndarray]]] = {}
+        for leaf, _, _ in (
+            p for pieces in piece_map.values() for p in pieces
+        ):
             # .copy(): never hand out views into the live shm buffer —
             # the next save would rewrite them under the caller.
             arr = (
@@ -497,32 +538,27 @@ class CheckpointEngine:
                 .copy()
             )
             leaf_map.setdefault(names[leaf.path], []).append((leaf, arr))
-        if target is not None:
-            # This host's shm may legitimately hold only a subset of the
-            # leaves (sharded engine dedups host-replicated leaves to one
-            # writer) — an incomplete shm restore must fall back to
-            # storage rather than silently keep freshly-init leaves.
-            names, _, _ = _tree_flatten_with_names(target)
-            if any(name not in leaf_map for name in names):
-                logger.info(
-                    "shm checkpoint incomplete for this host; falling "
-                    "back to storage"
-                )
-                return None
-        if not _covers_global(leaf_map):
-            logger.info(
-                "shm shards do not cover the global arrays (multi-host "
-                "state); falling back to storage"
-            )
-            return None
         state = _assemble(leaf_map)
         logger.info("restored step %s from shared memory", meta.step)
         return _fill_target(state, target, meta.step)
 
     def load_from_storage(self, path: str = "", target=None):
+        """Restore from a step directory.
+
+        With a ``target``, the restore is SHARD-WISE (reference
+        fsdp_engine.py:341 FileReader): only metas are unpickled, and
+        each target device shard reads just the byte ranges of the saved
+        pieces it intersects via ``np.memmap`` — peak extra host memory
+        is ~one shard, not the global array, so restoring a 7B-class
+        state into a *different* mesh cannot OOM the host. The trade:
+        slice reads skip the whole-payload CRC (the targetless eager
+        path keeps it).
+        """
         step_dir = path or self._latest_step_dir()
         if not step_dir or not os.path.isdir(step_dir):
             return None
+        if target is not None:
+            return self._load_storage_sharded(step_dir, target)
         entries: list[tuple[LeafMeta, np.ndarray]] = []
         step = -1
         for fname in sorted(os.listdir(step_dir)):
@@ -555,21 +591,103 @@ class CheckpointEngine:
                 "restore", step_dir,
             )
             return None
-        if target is not None:
-            # completeness bail-out (mirrors the shm path): a disk
-            # checkpoint missing whole leaves (e.g. after a model change)
-            # must not silently mix checkpointed and fresh-init values
-            tnames, _, _ = _tree_flatten_with_names(target)
-            missing = [n for n in tnames if n not in leaf_map]
-            if missing:
-                raise ValueError(
-                    f"checkpoint at {step_dir} is missing "
-                    f"{len(missing)} target leaves (e.g. {missing[:3]}) "
-                    f"— refusing a partial restore of a changed model"
-                )
         state = _assemble(leaf_map)
         logger.info("restored step %s from %s", step, step_dir)
         return _fill_target(state, target, step)
+
+    def _load_storage_sharded(self, step_dir: str, target):
+        """Meta-only scan + per-target-shard slice reads."""
+        import jax
+
+        from dlrover_tpu.agent.ckpt_saver import read_host_shard_meta
+
+        pieces_by_path: list[tuple[LeafMeta, str, int]] = []
+        step = -1
+        for fname in sorted(os.listdir(step_dir)):
+            if not fname.endswith(".dlck"):
+                continue
+            fpath = os.path.join(step_dir, fname)
+            result = read_host_shard_meta(fpath)
+            if result is None:
+                continue
+            meta, payload_start = result
+            step = max(step, meta.step)
+            for leaf in meta.leaves:
+                pieces_by_path.append((leaf, fpath, payload_start))
+        if not pieces_by_path:
+            return None
+        names = _translate_legacy_names(
+            sorted({leaf.path for leaf, _, _ in pieces_by_path})
+        )
+        piece_map: dict[str, list[tuple[LeafMeta, str, int]]] = {}
+        for leaf, fpath, ps in pieces_by_path:
+            piece_map.setdefault(names[leaf.path], []).append(
+                (leaf, fpath, ps)
+            )
+        meta_view = {
+            k: [(m, None) for m, _, _ in v] for k, v in piece_map.items()
+        }
+        if not _covers_global(meta_view):
+            logger.warning(
+                "checkpoint at %s is missing shards; refusing a partial "
+                "restore", step_dir,
+            )
+            return None
+        tnames, _, _ = _tree_flatten_with_names(target)
+        missing = [n for n in tnames if n not in piece_map]
+        if missing:
+            raise ValueError(
+                f"checkpoint at {step_dir} is missing "
+                f"{len(missing)} target leaves (e.g. {missing[:3]}) "
+                f"— refusing a partial restore of a changed model"
+            )
+        result = self._fill_from_pieces(piece_map, target, step, _read_box)
+        logger.info(
+            "restored step %s from %s (shard-wise)", step, step_dir
+        )
+        return result
+
+    def _fill_from_pieces(self, piece_map, target, step, read_box):
+        """Rebuild the target pytree shard-wise from saved pieces."""
+        import jax
+
+        tnames, tleaves, treedef = _tree_flatten_with_names(target)
+        new_leaves = []
+        for name, leaf_t in zip(tnames, tleaves):
+            pieces = piece_map[name]
+            want_shape = tuple(np.shape(leaf_t))
+            got_shape = tuple(
+                pieces[0][0].global_shape
+                if pieces[0][0].index is not None
+                else pieces[0][0].shape
+            )
+            if want_shape and got_shape != want_shape:
+                raise ValueError(
+                    f"checkpoint leaf {name} has shape {got_shape}, "
+                    f"target expects {want_shape} — refusing a silent "
+                    f"mismatched restore (stale or foreign checkpoint?)"
+                )
+            arr = _restore_leaf_to_sharding(pieces, leaf_t, read_box)
+            if arr is None:
+                host = _assemble_one(pieces, read_box)
+                if isinstance(leaf_t, jax.Array) and hasattr(
+                    leaf_t, "sharding"
+                ):
+                    host = jax.device_put(host, leaf_t.sharding)
+                elif isinstance(leaf_t, jax.ShapeDtypeStruct):
+                    sharding = getattr(leaf_t, "sharding", None)
+                    host = (
+                        jax.device_put(host, sharding)
+                        if sharding is not None
+                        else jax.numpy.asarray(host)
+                    )
+                else:
+                    host = np.array(host)  # detach from live shm views
+                arr = host
+            new_leaves.append(arr)
+        return (
+            jax.tree_util.tree_unflatten(treedef, new_leaves), step,
+        )
 
     def _latest_step_dir(self) -> str:
         step = AsyncCheckpointSaver.get_latest_step(self.checkpoint_dir)
@@ -610,6 +728,144 @@ def _covers_global(leaf_map) -> bool:
         if have < total:
             return False
     return True
+
+
+def _piece_slices(meta: "LeafMeta"):
+    """Global-coordinate region a saved piece covers. Index bounds may
+    be None on unsharded dims (a full-extent slice): normalise against
+    the piece's local shape."""
+    if meta.index is not None:
+        out = []
+        for (a, b), dim in zip(meta.index, meta.shape):
+            start = 0 if a is None else int(a)
+            stop = start + int(dim) if b is None else int(b)
+            out.append(slice(start, stop))
+        return tuple(out)
+    return tuple(slice(0, int(s)) for s in meta.shape)
+
+
+def _intersect_boxes(a, b):
+    out = []
+    for sa, sb in zip(a, b):
+        lo, hi = max(sa.start, sb.start), min(sa.stop, sb.stop)
+        if lo >= hi:
+            return None
+        out.append(slice(lo, hi))
+    return tuple(out)
+
+
+def _read_box(fpath: str, payload_start: int, meta: "LeafMeta", box):
+    """Materialise only the global-coordinate ``box`` of a saved piece:
+    the payload is memory-mapped, so the OS pages in just the touched
+    byte ranges (the FileReader-style lazy read)."""
+    ps = _piece_slices(meta)
+    local = tuple(
+        slice(b.start - p.start, b.stop - p.start)
+        for b, p in zip(box, ps)
+    )
+    mm = np.memmap(
+        fpath, dtype=np.dtype(meta.dtype), mode="r",
+        offset=payload_start + meta.offset, shape=tuple(meta.shape),
+    )
+    out = np.asarray(mm[local]) if local else np.asarray(mm)
+    del mm
+    return out
+
+
+def _norm_index(idx, global_shape):
+    out = []
+    for sl, dim in zip(idx, global_shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append(slice(start, stop))
+    return tuple(out)
+
+
+def _assemble_one(pieces, read_box=None):
+    """Eagerly assemble ONE leaf from (meta, src1, src2) pieces (used
+    for target leaves without a usable sharding)."""
+    if read_box is None:
+        read_box = _read_box
+    meta0 = pieces[0][0]
+    if len(pieces) == 1 and (
+        meta0.index is None
+        or tuple(meta0.shape) == tuple(meta0.global_shape)
+    ):
+        meta, s1, s2 = pieces[0]
+        return read_box(s1, s2, meta, _piece_slices(meta))
+    gshape = tuple(meta0.global_shape)
+    full = np.empty(gshape, dtype=np.dtype(meta0.dtype))
+    for meta, s1, s2 in pieces:
+        sl = _piece_slices(meta)
+        full[sl] = read_box(s1, s2, meta, sl)
+    return full
+
+
+def _restore_leaf_to_sharding(pieces, leaf_target, read_box=None):
+    """Build a sharded jax.Array for ``leaf_target`` by reading, for
+    each addressable device shard, only the intersecting saved byte
+    ranges. ``pieces`` are (meta, src1, src2) where the default
+    ``read_box`` memmaps (src1=path, src2=payload offset); the shm path
+    passes a reader slicing zero-copy views of the live buffer.
+    Returns None when the target carries no usable sharding (caller
+    assembles eagerly) or the pieces leave holes."""
+    import jax
+
+    if read_box is None:
+        read_box = _read_box
+    sharding = getattr(leaf_target, "sharding", None)
+    gshape = tuple(np.shape(leaf_target))
+    if sharding is None or not gshape:
+        return None
+    try:
+        dev_map = sharding.addressable_devices_indices_map(gshape)
+    except Exception:  # noqa: BLE001 - exotic shardings -> eager path
+        return None
+    dtype = np.dtype(pieces[0][0].dtype)
+    shard_arrays = []
+    host_cache: dict = {}  # box -> host buffer (replicated shards share)
+    for dev, idx in dev_map.items():
+        box_t = _norm_index(idx, gshape)
+        key = tuple((s.start, s.stop) for s in box_t)
+        out = host_cache.get(key)
+        if out is None:
+            out = np.empty(
+                tuple(s.stop - s.start for s in box_t), dtype
+            )
+            filled = 0
+            for meta, src1, src2 in pieces:
+                inter = _intersect_boxes(box_t, _piece_slices(meta))
+                if inter is None:
+                    continue
+                src = read_box(src1, src2, meta, inter)
+                dst = tuple(
+                    slice(i.start - b.start, i.stop - b.start)
+                    for i, b in zip(inter, box_t)
+                )
+                out[dst] = src
+                filled += src.size
+            if filled < out.size:
+                return None
+            host_cache[key] = out
+        shard_arrays.append(jax.device_put(out, dev))
+    return jax.make_array_from_single_device_arrays(
+        gshape, sharding, shard_arrays
+    )
+
+
+def _shm_read_box(buf, _unused, meta, box):
+    """Zero-copy reader over the live shm buffer (the per-shard ``out``
+    buffers are fresh allocations, so no view escapes)."""
+    view = np.frombuffer(
+        buf, dtype=np.dtype(meta.dtype), count=_count(meta.shape),
+        offset=meta.offset,
+    ).reshape(meta.shape)
+    ps = _piece_slices(meta)
+    local = tuple(
+        slice(b.start - p.start, b.stop - p.start)
+        for b, p in zip(box, ps)
+    )
+    return view[local] if local else view
 
 
 def _assemble(leaf_map) -> dict:

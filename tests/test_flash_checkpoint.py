@@ -155,6 +155,62 @@ class TestShardedEngine:
         assert restored["w"].sharding == target["w"].sharding
         engine.close()
 
+    def test_resharded_restore_is_shard_wise(self, tmp_path):
+        """Restoring into a DIFFERENT mesh must not materialise full
+        global arrays on the host (the 7B north-star would OOM): each
+        target shard memmap-reads only its intersecting saved byte
+        ranges, so peak host allocation stays ~one shard."""
+        
+
+        mesh1 = Mesh(np.array(jax.devices()), ("dp",))
+        G = (8192, 512)  # 16 MiB fp32
+        big = jax.device_put(
+            jnp.arange(G[0] * G[1], dtype=jnp.float32).reshape(G),
+            NamedSharding(mesh1, P("dp", None)),
+        )
+        engine = ShardedCheckpointEngine(str(tmp_path / "ckpt"))
+        assert engine.save_to_storage(70, {"big": big})
+        assert engine.wait_for_persist(70, timeout=30)
+        engine._shm_handler.mark_empty()
+
+        mesh2 = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+        target = {
+            "big": jax.device_put(
+                jnp.zeros(G), NamedSharding(mesh2, P("tp", "dp"))
+            ),
+        }
+        host_ref = np.asarray(jax.device_get(big))
+        # instrument host staging allocations: the shard-wise path's
+        # biggest single buffer is ONE target shard (2 MiB), where the
+        # old path allocated the 16 MiB global
+        import dlrover_tpu.trainer.flash_checkpoint.engine as eng_mod
+
+        allocs = []
+        real_empty = np.empty
+
+        def tracking_empty(shape, *a, **kw):
+            arr = real_empty(shape, *a, **kw)
+            allocs.append(arr.nbytes)
+            return arr
+
+        orig = eng_mod.np.empty
+        eng_mod.np.empty = tracking_empty
+        try:
+            restored, step = engine.load(target=target)
+        finally:
+            eng_mod.np.empty = orig
+        assert step == 70
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(restored["big"])), host_ref
+        )
+        assert restored["big"].sharding == target["big"].sharding
+        assert allocs, "no staging allocations traced"
+        assert max(allocs) <= 2 * (1 << 20), (
+            f"largest staging alloc {max(allocs)>>20} MiB — full-global "
+            f"materialisation crept back in"
+        )
+        engine.close()
+
     def test_shard_dedup(self, tmp_path):
         """Replicated-axis shards are written once, not once per device."""
         mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))

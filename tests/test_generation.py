@@ -302,3 +302,128 @@ class TestLMPPO:
         assert stats, "no update stats"
         assert np.isfinite(float(stats["policy_loss"]))
         assert np.isfinite(float(stats["value_loss"]))
+
+
+class TestMoEDecode:
+    """MoE policies decode through the same KV-cache path (VERDICT item:
+    rl/generation previously raised NotImplementedError for MoE)."""
+
+    def _moe_config(self):
+        return tiny_config(n_experts=4, moe_top_k=2, mlp_dim=32)
+
+    def test_prefill_logits_match_full_forward(self):
+        config = self._moe_config()
+        params = llama_init(config, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 9), 0, 64)
+        cache = init_kv_cache(config, 2, 32)
+        logits, _ = prefill(config, params, tokens, cache)
+        full = llama_apply(config, params, tokens)
+        # training moe_ffn enforces per-expert capacity (tokens can be
+        # dropped); decode computes the exact top-k mixture, so allow a
+        # loose tolerance driven by capacity-dropping differences only
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, -1]), atol=0.35
+        )
+
+    def test_generate_finite_and_reproducible(self):
+        config = self._moe_config()
+        params = llama_init(config, jax.random.key(0))
+        prompts = jax.random.randint(jax.random.key(1), (2, 5), 0, 64)
+        out = generate(config, params, prompts, jax.random.key(2),
+                       GenerateConfig(max_new_tokens=6))
+        assert out.sequences.shape == (2, 11)
+        assert np.isfinite(np.asarray(out.logprobs)).all()
+        out2 = generate(config, params, prompts, jax.random.key(2),
+                        GenerateConfig(max_new_tokens=6))
+        np.testing.assert_array_equal(
+            np.asarray(out.sequences), np.asarray(out2.sequences))
+
+    def test_backend_accepts_moe(self):
+        config = self._moe_config()
+        params = llama_init(config, jax.random.key(0))
+        backend = KVCacheGenerationBackend(
+            config, GenerateConfig(max_new_tokens=4))
+        out = backend.generate(params, np.zeros((1, 3), np.int32),
+                               jax.random.key(0))
+        assert out.sequences.shape == (1, 7)
+
+
+class TestPrefillLongerThanCache:
+    def test_keeps_last_window(self):
+        """P > C prompts keep the last C tokens (unique ring slots; a
+        single duplicate-index scatter has undefined winners)."""
+        config = tiny_config()
+        params = llama_init(config, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (2, 20), 0, 64)
+        C = 8
+        cache = init_kv_cache(config, 2, C)
+        logits, cache = prefill(config, params, tokens, cache)
+        # every cache slot must hold one of the LAST C positions
+        pos = np.sort(np.asarray(cache.pos))
+        np.testing.assert_array_equal(pos, np.arange(12, 20))
+        assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_first_token_rng_independent_of_scan_draws():
+    """Token 0 must use a split key, not the scan carry's ancestor."""
+    config = tiny_config()
+    params = llama_init(config, jax.random.key(0))
+    prompts = jax.random.randint(jax.random.key(1), (4, 5), 0, 64)
+    out = generate(config, params, prompts, jax.random.key(7),
+                   GenerateConfig(max_new_tokens=8, temperature=1.0))
+    # smoke: finite + deterministic under the same key
+    out2 = generate(config, params, prompts, jax.random.key(7),
+                    GenerateConfig(max_new_tokens=8, temperature=1.0))
+    np.testing.assert_array_equal(
+        np.asarray(out.sequences), np.asarray(out2.sequences))
+
+
+def test_lm_ppo_iteration_moe_policy():
+    """PPO e2e with an MoE policy through the KV-cache backend."""
+    from dlrover_tpu.rl import (
+        LMPPOTrainer,
+        ModelEngine,
+        ModelSpec,
+        PPOConfig,
+    )
+
+    config = tiny_config(n_experts=4, moe_top_k=2, mlp_dim=32)
+
+    def actor_apply(params, tokens):
+        return llama_apply(config, params, tokens)
+
+    def critic_init(rng):
+        return {"w": jax.random.normal(rng, (config.dim, 1)) * 0.02,
+                "emb": jax.random.normal(
+                    rng, (config.vocab_size, config.dim)) * 0.02}
+
+    def critic_apply(params, tokens):
+        h = params["emb"][tokens]
+        return (h @ params["w"])[..., 0]
+
+    engine = ModelEngine({
+        "actor": ModelSpec(
+            init_fn=lambda rng: llama_init(config, rng),
+            apply_fn=actor_apply, trainable=True,
+            optimizer=optax.adam(1e-4),
+        ),
+        "critic": ModelSpec(
+            init_fn=critic_init, apply_fn=critic_apply,
+            trainable=True, optimizer=optax.adam(1e-3),
+        ),
+    })
+
+    def score_fn(sequences, gen_mask):
+        gen = np.asarray(sequences)[:, -gen_mask.shape[1]:]
+        return (np.asarray(gen) % 2 == 0).mean(axis=1)
+
+    trainer = LMPPOTrainer(
+        engine, PPOConfig(ppo_epochs=1, train_batch_size=4),
+        llama_config=config, score_fn=score_fn,
+        gen=GenerateConfig(max_new_tokens=4, temperature=1.0),
+    )
+    prompts = {"tokens": np.asarray(
+        jax.random.randint(jax.random.key(5), (4, 5), 0, 64)
+    )}
+    stats = trainer.train([prompts], iterations=1)
+    assert stats and np.isfinite(float(stats["policy_loss"]))

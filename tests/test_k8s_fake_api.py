@@ -27,9 +27,11 @@ class FakeK8sApi:
 
     def __init__(self):
         self.pods: dict[str, dict] = {}
+        self.crs: dict[str, dict] = {}   # scaleplan CRs by name
         self.events: list[dict] = []
         self.cond = threading.Condition()
         self.server = None
+        self._rv = 0
 
     # ------------------------------------------------------------ store
 
@@ -98,12 +100,28 @@ class FakeK8sApi:
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", "0"))
-                pod = json.loads(self.rfile.read(n).decode())
-                api.create(pod)
-                self._json(201, pod)
+                obj = json.loads(self.rfile.read(n).decode())
+                if "/scaleplans" in self.path:
+                    with api.cond:
+                        api._rv += 1
+                        obj.setdefault("metadata", {})[
+                            "resourceVersion"] = str(api._rv)
+                        api.crs[obj["metadata"]["name"]] = obj
+                    self._json(201, obj)
+                    return
+                api.create(obj)
+                self._json(201, obj)
 
             def do_DELETE(self):
                 name = self.path.rsplit("/", 1)[-1]
+                if "/scaleplans/" in self.path:
+                    with api.cond:
+                        found = api.crs.pop(name, None)
+                    self._json(
+                        200 if found else 404,
+                        {"status": "Success" if found else "Failure"},
+                    )
+                    return
                 if api.delete(name):
                     self._json(200, {"status": "Success"})
                 else:
@@ -113,6 +131,14 @@ class FakeK8sApi:
                 parsed = urllib.parse.urlparse(self.path)
                 q = urllib.parse.parse_qs(parsed.query)
                 selector = q.get("labelSelector", [""])[0]
+                if "/scaleplans" in parsed.path:
+                    with api.cond:
+                        items = [
+                            c for c in api.crs.values()
+                            if api._matches(c, selector)
+                        ]
+                    self._json(200, {"items": items})
+                    return
                 if q.get("watch", ["false"])[0] != "true":
                     with api.cond:
                         items = [
@@ -293,3 +319,75 @@ class TestSchedulerAgainstFakeApi:
             assert _wait(lambda: "job2-worker-0" not in api.pods)
         finally:
             scaler.stop()
+
+
+class TestScalePlanWatcher:
+    """Manual scaling via a ScalePlan CR (reference k8s_watcher.py:226
+    K8sScalePlanWatcher + dist_job_manager.py:402): a manifest posted to
+    the API server changes the pod count through the master's own
+    auto-scaler execute path."""
+
+    def test_manual_scaleplan_changes_pod_count(self, fake_api):
+        from dlrover_tpu.master.auto_scaler import (
+            AllreduceTrainingAutoScaler,
+        )
+        from dlrover_tpu.master.job_manager import DistributedJobManager
+        from dlrover_tpu.master.scaleplan_watcher import ScalePlanWatcher
+        from dlrover_tpu.scheduler.crd import ScalePlanSpec
+        from dlrover_tpu.scheduler.job import new_job_args
+
+        api, url = fake_api
+        client = RestK8sClient(base_url=url)
+        scaler = PodScaler("job3", client)
+        args = new_job_args("local", "job3", node_num=1)
+        mgr = DistributedJobManager(args, scaler=scaler)
+        with mgr._lock:
+            mgr._job_nodes = {
+                NodeType.WORKER: {0: Node(NodeType.WORKER, 0)}
+            }
+            mgr._next_node_id[NodeType.WORKER] = 1
+        auto = AllreduceTrainingAutoScaler(
+            mgr, scaler=scaler, target_worker_num=1
+        )
+
+        def apply(plan):
+            auto.execute_job_optimization_plan(plan)
+            group = plan.node_group_resources.get(NodeType.WORKER)
+            if group is not None:
+                auto.on_group_count_applied(group.count)
+
+        watcher = ScalePlanWatcher("job3", client, apply, interval=0.2)
+        try:
+            # user: kubectl apply -f scaleplan.yaml
+            manifest = ScalePlanSpec(
+                job_name="job3", name="job3-scale-up",
+                replica_counts={NodeType.WORKER: 3},
+            ).to_manifest()
+            assert client.create_custom_resource("scaleplans", manifest)
+            assert watcher.poll_once() == 1
+            # the plan created 2 extra workers; the scaler materializes
+            # pods for the whole group
+            assert _wait(lambda: len(api.pods) == 3), api.pods
+            # the CR is deleted as the apply acknowledgement
+            assert api.crs == {}
+            # re-polling must not re-apply
+            assert watcher.poll_once() == 0
+        finally:
+            watcher.stop()
+            scaler.stop()
+
+    def test_non_matching_job_ignored(self, fake_api):
+        from dlrover_tpu.master.scaleplan_watcher import ScalePlanWatcher
+        from dlrover_tpu.scheduler.crd import ScalePlanSpec
+
+        api, url = fake_api
+        client = RestK8sClient(base_url=url)
+        applied = []
+        watcher = ScalePlanWatcher("jobA", client, applied.append)
+        manifest = ScalePlanSpec(
+            job_name="other-job", name="other-scale",
+            replica_counts={NodeType.WORKER: 5},
+        ).to_manifest()
+        client.create_custom_resource("scaleplans", manifest)
+        assert watcher.poll_once() == 0
+        assert applied == []

@@ -59,22 +59,24 @@ def test_flash_attention_gqa_heads():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
 
 
+@pytest.mark.parametrize("fused", [True, False])
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("heads,kv_heads", [(4, 4), (8, 2)])
-def test_flash_attention_bshd_forward(causal, heads, kv_heads):
+def test_flash_attention_bshd_forward(causal, heads, kv_heads, fused):
     """The model-native [B,S,H,Dh] kernels match the BHSD reference."""
     q, k, v = _qkv(heads=heads, kv_heads=kv_heads)
     qs, ks, vs = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out = flash_attention_bshd(qs, ks, vs, causal=causal,
-                               block_q=64, block_k=64)
+                               block_q=64, block_k=64, fused=fused)
     ref = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(
         np.asarray(out.transpose(0, 2, 1, 3)), np.asarray(ref), atol=2e-2
     )
 
 
+@pytest.mark.parametrize("fused", [True, False])
 @pytest.mark.parametrize("q_len,kv_len", [(128, 128), (96, 200)])
-def test_flash_attention_bshd_grads_match_reference(q_len, kv_len):
+def test_flash_attention_bshd_grads_match_reference(q_len, kv_len, fused):
     rng = np.random.RandomState(7)
     q = jnp.asarray(rng.randn(2, 8, q_len, 64), jnp.float32)
     k = jnp.asarray(rng.randn(2, 2, kv_len, 64), jnp.float32)
@@ -83,7 +85,7 @@ def test_flash_attention_bshd_grads_match_reference(q_len, kv_len):
     def loss_bshd(q, k, v):
         o = flash_attention_bshd(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3), block_q=64, block_k=64)
+            v.transpose(0, 2, 1, 3), block_q=64, block_k=64, fused=fused)
         return jnp.sum(o ** 2)
 
     def loss_ref(q, k, v):

@@ -3,8 +3,11 @@
 Usage: python bench_step.py [attn_impl] [block_q] [block_k] [bwd_q] [bwd_k]
 """
 import dataclasses
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

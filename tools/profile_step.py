@@ -1,8 +1,11 @@
 """Profile the nano-350m train step; print top HLO ops by self time."""
 import dataclasses
 import glob
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
