@@ -94,6 +94,35 @@ def optimize_oom_memory(store: MetricsStore, req: OptimizeRequest):
     return {"memory_mb": int(current * factor)}
 
 
+@register("worker_create_oom")
+def optimize_worker_create_oom(store: MetricsStore, req: OptimizeRequest):
+    """First-worker sizing for a job whose HISTORY contains OOMs
+    (reference optimize_job_worker_create_oom_resource.go): start the
+    new run at the historical peak memory times an OOM margin, with a
+    minimum increase over the last OOM'd allocation — distinct from
+    the runtime ``oom_memory`` doubling, which reacts to an OOM in the
+    CURRENT run.
+    """
+    margin = float(req.config.get("oom_margin_percent", 0.2))
+    min_increase = float(req.config.get("min_increase_mb", 1024))
+    histories = store.similar_job_records(req.job_name)
+    peak = 0.0
+    oom_alloc = 0.0
+    saw_oom = False
+    for records in histories:
+        for r in records:
+            if r.get("used_memory_mb"):
+                peak = max(peak, float(r["used_memory_mb"]))
+            if r.get("oom"):
+                saw_oom = True
+                if r.get("memory_mb"):
+                    oom_alloc = max(oom_alloc, float(r["memory_mb"]))
+    if not saw_oom or peak <= 0:
+        return None
+    target = max(peak * (1.0 + margin), oom_alloc + min_increase)
+    return {"memory_mb": int(target)}
+
+
 @register("worker_count")
 def optimize_worker_count(store: MetricsStore, req: OptimizeRequest,
                           min_efficiency: float = 0.7):

@@ -38,6 +38,10 @@ _SAFE_GLOBALS: dict[str, set | None] = {
     "numpy": {"ndarray", "dtype", "float32", "float64", "int32", "int64"},
     "numpy.core.multiarray": {"_reconstruct", "scalar"},
     "numpy._core.multiarray": {"_reconstruct", "scalar"},
+    # numpy >= 2 pickles array data through _frombuffer (a plain
+    # bytes -> ndarray constructor; no code execution surface)
+    "numpy.core.numeric": {"_frombuffer"},
+    "numpy._core.numeric": {"_frombuffer"},
 }
 
 

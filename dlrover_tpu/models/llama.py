@@ -79,6 +79,11 @@ class LlamaConfig:
     # pipe*V, and microbatches divisible by pipe). The pipe-sharded
     # layer stack is applied in interleaved_layer_order.
     pipe_virtual_stages: int = 1
+    # sequence chunks for the fused linear CE (1 = materialise full
+    # logits). n>1 bounds peak logits memory to [B, S/n, V] by
+    # recomputing each chunk's logits in the backward — the lever that
+    # makes large per-device batches fit HBM at 32k vocab.
+    ce_chunks: int = 1
     # MoE (mixtral-style FFN swap): 0/1 experts = dense
     n_experts: int = 0
     moe_top_k: int = 2
@@ -446,11 +451,14 @@ def _stage_fn(config: LlamaConfig):
 
 
 def llama_apply(config: LlamaConfig, params, tokens, positions=None,
-                return_aux: bool = False):
+                return_aux: bool = False, return_hidden: bool = False):
     """tokens [B, S] int32 -> logits [B, S, vocab] float32.
 
     With ``return_aux=True`` also returns the summed auxiliary loss
-    (MoE load-balancing + router z-loss; zero for dense models)."""
+    (MoE load-balancing + router z-loss; zero for dense models).
+    ``return_hidden=True`` returns the PRE-final-norm hidden states
+    instead of logits (the chunked-CE loss applies norm + head itself,
+    chunk by chunk)."""
     dtype = jnp.dtype(config.dtype)
     B, S = tokens.shape
     if positions is None:
@@ -475,6 +483,10 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None,
     else:
         x, aux_total = stage_fn(params["layers"], x, cos, sin)
 
+    if return_hidden:
+        if return_aux:
+            return x, aux_total
+        return x
     x = _rms_norm(x, params["final_norm"], config.norm_eps)
     logits = x @ params["lm_head"].astype(dtype)
     logits = shard_logical(logits, ("batch", "seq", "vocab"))
@@ -546,10 +558,28 @@ def llama_loss_fn(config: LlamaConfig):
         tokens = batch["tokens"]
         if config.pipe_schedule == "1f1b" and pipe_size() > 1:
             return _llama_1f1b_loss(config, params, tokens)
+        labels = tokens[:, 1:]
+        if config.ce_chunks > 1:
+            from dlrover_tpu.ops.cross_entropy import (
+                fused_linear_cross_entropy,
+            )
+
+            h, aux = llama_apply(
+                config, params, tokens[:, :-1], return_aux=True,
+                return_hidden=True,
+            )
+            dtype = jnp.dtype(config.dtype)
+            loss_sum, valid_sum = fused_linear_cross_entropy(
+                h, params["lm_head"].astype(dtype), labels,
+                n_chunks=config.ce_chunks,
+                norm_fn=lambda t: _rms_norm(
+                    t, params["final_norm"], config.norm_eps
+                ),
+            )
+            return loss_sum / jnp.maximum(valid_sum, 1) + aux
         logits, aux = llama_apply(
             config, params, tokens[:, :-1], return_aux=True
         )
-        labels = tokens[:, 1:]
         loss, valid = softmax_cross_entropy(logits, labels)
         return loss.sum() / jnp.maximum(valid.sum(), 1) + aux
 

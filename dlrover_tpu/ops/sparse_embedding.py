@@ -76,6 +76,38 @@ class IdMapper:
                 [self._freq.get(int(i), 0) for i in flat], np.int64
             ).reshape(np.shape(ids))
 
+    def evict_ids(self, raws: list[int]) -> dict[int, int]:
+        """Free specific ids' slots; returns {raw_id: freed_slot}.
+        Frequencies are kept (the id may live on in a host tier)."""
+        freed = {}
+        with self._lock:
+            for raw in raws:
+                slot = self._slot_of.pop(int(raw), None)
+                if slot is not None:
+                    self._free.append(slot)
+                    freed[int(raw)] = slot
+        return freed
+
+    def resident_by_frequency(self) -> list[tuple[int, int]]:
+        """Resident (raw_id, freq) pairs, coldest first."""
+        with self._lock:
+            return sorted(
+                ((raw, self._freq.get(raw, 0))
+                 for raw in self._slot_of),
+                key=lambda kv: kv[1],
+            )
+
+    def free_slots(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def slots_of(self, raws: list[int]) -> dict[int, int]:
+        with self._lock:
+            return {
+                int(r): self._slot_of[int(r)]
+                for r in raws if int(r) in self._slot_of
+            }
+
     def evict_under_threshold(self, threshold: int) -> list[int]:
         """Free the slots of ids seen fewer than ``threshold`` times
         (the reference's under-threshold export filtering / eviction).
@@ -215,3 +247,146 @@ class KvEmbedding:
             return table
         idx = np.asarray(freed, np.int32)
         return jnp.asarray(table).at[idx].set(0.0)
+
+
+class TieredKvEmbedding(KvEmbedding):
+    """KvEmbedding whose vocabulary may exceed the device table.
+
+    Equivalent capability: TFPlus hybrid embedding storage
+    (tfplus/tfplus/kv_variable/kernels/hybrid_embedding/table_manager.h
+    — hot ids in device memory, cold ids spilled to a host tier, with
+    frequency-driven placement).
+
+    TPU redesign: the device table keeps its fixed [capacity, dim]
+    shape (XLA-static); tiering happens on the host BETWEEN steps.
+    ``prepare_batch`` guarantees every id of the incoming batch is
+    device-resident before the step: when slots run short it demotes
+    the least-frequently-used resident ids that are NOT in the batch —
+    reading back only those rows from the device (a gather, not a full
+    table download) into the host store — and promotes the batch's
+    spilled rows with one scatter. Training then touches device rows
+    only; demoted rows keep their learned values and frequencies, so a
+    returning id resumes exactly where it left off.
+    """
+
+    def __init__(self, dim: int, capacity: int = 1 << 16,
+                 init_scale: float = 0.01, dtype=None, seed: int = 0):
+        super().__init__(dim, capacity, init_scale, dtype)
+        self._host_store: dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def host_ids(self) -> int:
+        return len(self._host_store)
+
+    def prepare_batch(self, table, raw_ids):
+        """Make every id in ``raw_ids`` device-resident.
+
+        Returns ``(table, slots)`` — ``table`` possibly updated by the
+        demotion/promotion scatter, ``slots`` aligned with ``raw_ids``
+        (feed to :meth:`embed` inside jit).
+        """
+        import jax.numpy as jnp
+
+        flat = np.asarray(raw_ids).reshape(-1)
+        uniq = list(dict.fromkeys(int(r) for r in flat))
+        resident = self.mapper.slots_of(uniq)
+        incoming = [r for r in uniq if r not in resident]
+        need = len(incoming) - self.mapper.free_slots()
+        if len(incoming) > self.capacity:
+            raise RuntimeError(
+                f"batch needs {len(incoming)} new rows but the device "
+                f"table holds {self.capacity}"
+            )
+        if need > 0:
+            # demote the coldest residents that the batch doesn't use
+            batch_set = set(uniq)
+            victims = [
+                raw for raw, _f in self.mapper.resident_by_frequency()
+                if raw not in batch_set
+            ][:need]
+            if len(victims) < need:
+                raise RuntimeError(
+                    "cannot make room: batch uses the whole table"
+                )
+            vslots = self.mapper.slots_of(victims)
+            order = list(vslots)
+            idx = np.asarray([vslots[r] for r in order], np.int32)
+            rows = np.asarray(jnp.take(jnp.asarray(table), idx, axis=0))
+            for r, row in zip(order, rows):
+                self._host_store[r] = np.array(row)
+            self.mapper.evict_ids(order)
+        # promote/insert the batch's non-resident ids
+        slots_new = self.mapper.lookup(
+            np.asarray(incoming, np.int64), count=False
+        ) if incoming else np.zeros((0,), np.int32)
+        if incoming:
+            up_rows = np.empty((len(incoming), self.dim), np.float64)
+            for i, raw in enumerate(incoming):
+                spilled = self._host_store.pop(raw, None)
+                if spilled is None:
+                    spilled = (
+                        self._rng.randn(self.dim) * self.init_scale
+                    )
+                up_rows[i] = spilled
+            table = jnp.asarray(table).at[
+                np.asarray(slots_new, np.int32)
+            ].set(jnp.asarray(up_rows, jnp.asarray(table).dtype))
+        # count a use for every id in the batch and map to slots
+        slots = self.mapper.lookup(flat)
+        return table, slots.reshape(np.shape(raw_ids))
+
+    # ------------------------------------------------------- ckpt/export
+
+    def export(self, table, min_frequency: int = 0):
+        """(ids, vectors, freqs) across BOTH tiers."""
+        ids_d, rows_d, freqs_d = super().export(table, min_frequency)
+        ids, rows, freqs = list(ids_d), list(rows_d), list(freqs_d)
+        for raw, row in self._host_store.items():
+            f = int(self.mapper.frequencies([raw])[0])
+            if f < min_frequency:
+                continue
+            ids.append(raw)
+            rows.append(np.asarray(row))
+            freqs.append(f)
+        if not ids:
+            return ids_d, rows_d, freqs_d
+        return (
+            np.asarray(ids, np.int64),
+            np.stack(rows),
+            np.asarray(freqs, np.int64),
+        )
+
+    def import_(self, table, ids, vectors, freqs=None):
+        """Load triples: fills the device table until full, spills the
+        rest to the host tier."""
+        ids = np.asarray(ids)
+        vectors = np.asarray(vectors)
+        n_dev = min(len(ids), self.mapper.free_slots())
+        if n_dev:
+            table = super().import_(
+                table, ids[:n_dev], vectors[:n_dev],
+                None if freqs is None else np.asarray(freqs)[:n_dev],
+            )
+        for i in range(n_dev, len(ids)):
+            raw = int(ids[i])
+            self._host_store[raw] = np.array(vectors[i])
+            if freqs is not None:
+                with self.mapper._lock:
+                    self.mapper._freq[raw] = int(np.asarray(freqs)[i])
+        return table
+
+    def state_dict(self) -> dict:
+        return {
+            "mapper": self.mapper.state_dict(),
+            "host_store": {
+                int(k): np.asarray(v) for k, v in self._host_store.items()
+            },
+        }
+
+    def load_state_dict(self, state: dict):
+        self.mapper.load_state_dict(state["mapper"])
+        self._host_store = {
+            int(k): np.asarray(v)
+            for k, v in state["host_store"].items()
+        }

@@ -210,3 +210,114 @@ class TestMasterIntegration:
         records = client.get_job_metrics("job-r")
         assert records and records[0]["status"] == "running"
         assert "worker_count" in records[0]
+
+
+class TestWorkerCreateOom:
+    """First-worker sizing from OOM history (reference
+    optimize_job_worker_create_oom_resource.go)."""
+
+    def test_sizes_above_historical_peak_and_oom_alloc(self):
+        from dlrover_tpu.brain.algorithms import get_algorithm
+        from dlrover_tpu.brain.datastore import MetricsStore
+        from dlrover_tpu.brain.messages import OptimizeRequest
+
+        store = MetricsStore()
+        store.persist("u1", "train-llm", {
+            "used_memory_mb": 9000, "oom": 1, "memory_mb": 10000,
+        })
+        store.persist("u2", "train-llm", {"used_memory_mb": 7000})
+        fn = get_algorithm("worker_create_oom")
+        plan = fn(store, OptimizeRequest(
+            job_uuid="u3", job_name="train-llm", config={},
+        ))
+        # >= peak * 1.2 AND >= oom allocation + 1 GiB
+        assert plan["memory_mb"] >= 11000
+        store.close()
+
+    def test_no_oom_history_returns_none(self):
+        from dlrover_tpu.brain.algorithms import get_algorithm
+        from dlrover_tpu.brain.datastore import MetricsStore
+        from dlrover_tpu.brain.messages import OptimizeRequest
+
+        store = MetricsStore()
+        store.persist("u1", "clean-job", {"used_memory_mb": 9000})
+        fn = get_algorithm("worker_create_oom")
+        assert fn(store, OptimizeRequest(
+            job_uuid="u2", job_name="clean-job", config={},
+        )) is None
+        store.close()
+
+
+class TestClusterMonitor:
+    def test_sweep_aggregates_jobs_and_ooms(self):
+        from dlrover_tpu.brain.datastore import MetricsStore
+        from dlrover_tpu.brain.monitor import ClusterMonitor
+
+        class FakeClient:
+            def list_pods(self, selector):
+                def pod(job, uid, phase, oom=False):
+                    status = {"phase": phase}
+                    if oom:
+                        status["containerStatuses"] = [{
+                            "lastState": {"terminated": {
+                                "reason": "OOMKilled"}},
+                        }]
+                    return {
+                        "metadata": {"labels": {
+                            "elasticjob-name": job, "job-uid": uid,
+                        }},
+                        "status": status,
+                    }
+
+                return {"items": [
+                    pod("job-a", "ua", "Running"),
+                    pod("job-a", "ua", "Failed", oom=True),
+                    pod("job-b", "ub", "Running"),
+                ]}
+
+        store = MetricsStore()
+        mon = ClusterMonitor(store, FakeClient(), interval=999)
+        assert mon.poll_once() == 2
+        rec_a = store.job_records("ua")[0]
+        assert rec_a["worker_count"] == 2
+        assert rec_a["oom"] == 1
+        assert rec_a["failed"] == 1
+        rec_b = store.job_records("ub")[0]
+        assert rec_b["worker_count"] == 1
+        store.close()
+
+    def test_monitor_feeds_worker_create_oom(self):
+        """End to end: monitor records an OOM'd run; the next run's
+        cold sizing picks it up."""
+        from dlrover_tpu.brain.algorithms import get_algorithm
+        from dlrover_tpu.brain.datastore import MetricsStore
+        from dlrover_tpu.brain.monitor import ClusterMonitor
+        from dlrover_tpu.brain.messages import OptimizeRequest
+
+        class FakeClient:
+            def list_pods(self, selector):
+                return {"items": [{
+                    "metadata": {"labels": {
+                        "elasticjob-name": "llm", "job-uid": "r1",
+                    }},
+                    "status": {
+                        "phase": "Failed",
+                        "containerStatuses": [{
+                            "state": {"terminated": {
+                                "reason": "OOMKilled"}},
+                        }],
+                    },
+                }]}
+
+        store = MetricsStore()
+        ClusterMonitor(store, FakeClient()).poll_once()
+        # a reporter also recorded the run's memory numbers
+        store.persist("r1", "llm", {
+            "used_memory_mb": 15000, "memory_mb": 16000, "oom": 1,
+        })
+        plan = get_algorithm("worker_create_oom")(
+            store, OptimizeRequest(job_uuid="r2", job_name="llm",
+                                   config={}),
+        )
+        assert plan["memory_mb"] >= 18000
+        store.close()

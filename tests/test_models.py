@@ -132,3 +132,40 @@ def dataclasses_asdict(cfg):
     import dataclasses
 
     return dataclasses.asdict(cfg)
+
+
+@pytest.mark.parametrize("seq_plus_one", [17, 18])
+def test_chunked_ce_matches_full_logits_loss(seq_plus_one):
+    """fused_linear_cross_entropy (ce_chunks>1) must reproduce the
+    full-logits loss and grads exactly (it only reorders compute) —
+    including when S is NOT a chunk multiple (S=17: padded rows carry
+    ignore_index and contribute nothing)."""
+    from dlrover_tpu.models.llama import LlamaConfig
+    from dlrover_tpu.models import llama_init, llama_loss_fn
+
+    base = dict(
+        vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=32, attn_impl="reference", remat=False,
+        dtype="float32",
+    )
+    cfg_full = LlamaConfig(**base)
+    cfg_chunk = LlamaConfig(**base, ce_chunks=4)
+    params = llama_init(cfg_full, jax.random.key(0))
+    tokens = np.array(jax.random.randint(
+        jax.random.key(1), (4, seq_plus_one), 0, 64))
+    tokens[0, 9:] = -100  # ignore_index padding crosses chunks
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    lf, gf = jax.value_and_grad(
+        lambda p: llama_loss_fn(cfg_full)(p, batch, None))(params)
+    lc, gc = jax.value_and_grad(
+        lambda p: llama_loss_fn(cfg_chunk)(p, batch, None))(params)
+    np.testing.assert_allclose(float(lc), float(lf), rtol=1e-6)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(gf)[0],
+        jax.tree_util.tree_flatten_with_path(gc)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-7,
+            err_msg=str(path),
+        )

@@ -193,3 +193,91 @@ class TestGroupAdagrad:
         u = np.asarray(updates["t"])
         assert np.all(u[[0, 1, 3]] == 0)
         assert np.all(u[2] != 0)
+
+
+class TestTieredKvEmbedding:
+    """Host-tier spill for vocabularies larger than the device table
+    (reference hybrid_embedding/table_manager.h capability)."""
+
+    def _kv(self, capacity=8, dim=4):
+        from dlrover_tpu.ops.sparse_embedding import TieredKvEmbedding
+
+        return TieredKvEmbedding(dim=dim, capacity=capacity, seed=1)
+
+    def test_values_survive_demote_promote(self):
+        kv = self._kv(capacity=4, dim=3)
+        table = kv.init_table(jax.random.key(0))
+        # write known vectors for ids 0..3 (fills the table)
+        table = kv.import_(
+            table, np.arange(4), np.arange(12).reshape(4, 3) * 1.0
+        )
+        # a batch of fresh ids forces demotion of the coldest residents
+        table, _ = kv.prepare_batch(table, np.asarray([100, 101, 102]))
+        assert kv.host_ids >= 3
+        # ask for an originally-written id again: promoted with its row
+        table, slots = kv.prepare_batch(table, np.asarray([2]))
+        row = np.asarray(KvEmbedding.embed(table, slots))[0]
+        np.testing.assert_allclose(row, [6.0, 7.0, 8.0])
+
+    def test_trains_vocab_larger_than_table(self):
+        """24 ids through an 8-row device table: every id's embedding
+        converges to its target despite constant spill/promote."""
+        kv = self._kv(capacity=8, dim=4)
+        table = kv.init_table(jax.random.key(0))
+        vocab = 24
+        rng = np.random.RandomState(0)
+        targets = rng.randn(vocab, 4).astype(np.float32)
+
+        @jax.jit
+        def step(table, slots, tgt):
+            def loss(tb):
+                e = KvEmbedding.embed(tb, slots)
+                return jnp.mean((e - tgt) ** 2)
+
+            g = jax.grad(loss)(table)
+            return table - 3.0 * g
+
+        for epoch in range(60):
+            order = rng.permutation(vocab)
+            for start in range(0, vocab, 6):
+                ids = order[start:start + 6]
+                table, slots = kv.prepare_batch(table, ids)
+                table = step(table, slots, jnp.asarray(targets[ids]))
+
+        # verify EVERY id (promoting in groups that fit the table)
+        errs = []
+        for start in range(0, vocab, 8):
+            ids = np.arange(start, min(start + 8, vocab))
+            table, slots = kv.prepare_batch(table, ids)
+            got = np.asarray(KvEmbedding.embed(table, slots))
+            errs.append(np.abs(got - targets[ids]).max())
+        assert max(errs) < 0.05, errs
+
+    def test_export_covers_both_tiers(self):
+        kv = self._kv(capacity=4, dim=2)
+        table = kv.init_table(jax.random.key(0))
+        table = kv.import_(
+            table, np.arange(10), np.arange(20).reshape(10, 2) * 1.0
+        )
+        assert kv.host_ids == 6  # overflow spilled
+        ids, rows, _ = kv.export(table)
+        assert sorted(ids.tolist()) == list(range(10))
+        by_id = {int(i): r for i, r in zip(ids, rows)}
+        np.testing.assert_allclose(by_id[9], [18.0, 19.0])
+
+    def test_state_roundtrip(self):
+        from dlrover_tpu.ops.sparse_embedding import TieredKvEmbedding
+
+        kv = self._kv(capacity=4, dim=2)
+        table = kv.init_table(jax.random.key(0))
+        table = kv.import_(
+            table, np.arange(6), np.ones((6, 2)), freqs=np.arange(6)
+        )
+        state = kv.state_dict()
+        kv2 = TieredKvEmbedding(dim=2, capacity=4)
+        kv2.load_state_dict(state)
+        assert kv2.host_ids == kv.host_ids
+        np.testing.assert_array_equal(
+            kv2.mapper.frequencies(np.arange(6)),
+            kv.mapper.frequencies(np.arange(6)),
+        )

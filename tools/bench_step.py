@@ -26,15 +26,19 @@ def main():
     bk = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
     bwq = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     bwk = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+    batch_arg = int(sys.argv[6]) if len(sys.argv) > 6 else 8
+    remat_arg = sys.argv[7] if len(sys.argv) > 7 else "none"
+    ce_chunks = int(sys.argv[8]) if len(sys.argv) > 8 else 1
 
     config = dataclasses.replace(
         PRESETS["nano-350m"], attn_impl=impl, attn_block_q=bq,
-        attn_block_k=bk, attn_bwd_block_q=bwq, attn_bwd_block_k=bwk)
-    batch, seq, steps = 8, 2048, 30
+        attn_block_k=bk, attn_bwd_block_q=bwq, attn_bwd_block_k=bwk,
+        ce_chunks=ce_chunks)
+    batch, seq, steps = batch_arg, 2048, 30
 
     strategy = Strategy(
         mesh=MeshConfig(data=1, fsdp=1), compute_dtype="bfloat16",
-        remat="none", donate=True)
+        remat=remat_arg, donate=True)
     res = auto_accelerate(
         llama_loss_fn(config), lambda rng: llama_init(config, rng),
         optax.adafactor(1e-3), llama_logical_axes(config),
@@ -54,7 +58,7 @@ def main():
         12 * config.n_layers * config.dim * batch * seq * seq // 2)
     print(f"impl={sys.argv[1] if len(sys.argv) > 1 else impl} "
           f"blocks=({bq},{bk},{bwq},{bwk}) "
-          f"step={dt*1e3:.1f} ms tok/s={batch*seq/dt:.0f} "
+          f"batch={batch} remat={remat_arg} ce={ce_chunks} step={dt*1e3:.1f} ms tok/s={batch*seq/dt:.0f} "
           f"mfu={flops/dt/197e12*100:.2f}%")
 
 
