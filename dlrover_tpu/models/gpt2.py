@@ -37,6 +37,9 @@ class GPT2Config:
     attn_impl: str = "flash"
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # backward-kernel tile overrides (0 = use the forward blocks)
+    attn_bwd_block_q: int = 0
+    attn_bwd_block_k: int = 0
     tie_lm_head: bool = True
     # 0 = auto (pipeline_apply picks 2*stages); same contract as llama
     pipe_microbatches: int = 0
@@ -148,19 +151,37 @@ def _block(config: GPT2Config, x, p):
     h, hd = config.n_heads, config.head_dim
     dtype = x.dtype
 
-    y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], config.norm_eps)
-    qkv = qdot(y, p["w_qkv"].astype(dtype)) + p["b_qkv"].astype(dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, h, hd)
-    k = k.reshape(B, S, h, hd)
-    v = v.reshape(B, S, h, hd)
-    # shared attention dispatcher (llama family): flash Pallas kernel,
-    # reference softmax, or ring/Ulysses when the seq mesh axis is active
-    from dlrover_tpu.models.llama import _attention
+    from dlrover_tpu.models.llama import (
+        _attention,
+        bhsd_flash_attention,
+        flash_einsum_path,
+    )
 
-    attn = _attention(config, q, k, v).reshape(B, S, D)
-    x = x + qdot(attn, p["w_proj"].astype(dtype)) \
-        + p["b_proj"].astype(dtype)
+    y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], config.norm_eps)
+    if flash_einsum_path(config):
+        # einsum-form qkv: heads land directly in the kernel's
+        # [B,H,S,Dh] layout — the layout permutation rides the matmul
+        # instead of materialising per-layer transpose copies (same
+        # trick as llama's _layer; gate + dispatch shared via llama)
+        w4 = p["w_qkv"].astype(dtype).reshape(D, 3, h, hd)
+        b4 = p["b_qkv"].astype(dtype).reshape(3, 1, h, 1, hd)
+        qkv4 = jnp.einsum("bsd,dthk->tbhsk", y, w4) + b4
+        out = bhsd_flash_attention(config, qkv4[0], qkv4[1], qkv4[2])
+        attn_out = jnp.einsum(
+            "bhsk,hkd->bsd", out,
+            p["w_proj"].astype(dtype).reshape(h, hd, D))
+        x = x + attn_out + p["b_proj"].astype(dtype)
+    else:
+        qkv = qdot(y, p["w_qkv"].astype(dtype)) + p["b_qkv"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, h, hd)
+        k = k.reshape(B, S, h, hd)
+        v = v.reshape(B, S, h, hd)
+        # shared attention dispatcher (llama family): flash Pallas
+        # kernel, reference softmax, or ring/Ulysses under a seq axis
+        attn = _attention(config, q, k, v).reshape(B, S, D)
+        x = x + qdot(attn, p["w_proj"].astype(dtype)) \
+            + p["b_proj"].astype(dtype)
     x = shard_logical(x, ("batch", "seq", "embed"))
 
     y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], config.norm_eps)

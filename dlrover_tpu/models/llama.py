@@ -336,6 +336,25 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt, layout: str = "bhsd"):
     )(qt, kt, vt)
 
 
+def flash_einsum_path(config) -> bool:
+    """Whether the einsum-form flash branch applies: projections write
+    the kernel's [B,H,S,Dh] layout directly (layout rides the matmuls).
+    Shared by the llama and gpt2 blocks so gating never diverges."""
+    return (
+        config.attn_impl == "flash"
+        and not _seq_axis_active()
+        and not fp8_enabled()
+    )
+
+
+def bhsd_flash_attention(config, qt, kt, vt):
+    """Shard + run the Pallas flash kernel on [B,H,S,Dh] operands."""
+    qt = shard_logical(qt, ("batch", "heads", "seq", "head_dim"))
+    kt = shard_logical(kt, ("batch", "kv_heads", "seq", "head_dim"))
+    vt = shard_logical(vt, ("batch", "kv_heads", "seq", "head_dim"))
+    return _sharded_flash(config, qt, kt, vt)
+
+
 def _seq_axis_active() -> bool:
     from dlrover_tpu.parallel.mesh import get_mesh
 
@@ -380,8 +399,7 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
     h, kvh, hd = config.n_heads, config.n_kv_heads, config.head_dim
 
     y = _rms_norm(x, p["attn_norm"], config.norm_eps)
-    if (config.attn_impl == "flash" and not _seq_axis_active()
-            and not fp8_enabled()):
+    if flash_einsum_path(config):
         # einsum-form projections: q/k/v are produced directly in the
         # kernel's [B,H,S,Dh] layout and the output projection contracts
         # (h, k) straight back to [B,S,D] — the layout permutation rides
@@ -394,10 +412,7 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
                         p["wv"].astype(dtype).reshape(D, kvh, hd))
         qt = _rope_apply_bhsd(qt, rope_cos, rope_sin)
         kt = _rope_apply_bhsd(kt, rope_cos, rope_sin)
-        qt = shard_logical(qt, ("batch", "heads", "seq", "head_dim"))
-        kt = shard_logical(kt, ("batch", "kv_heads", "seq", "head_dim"))
-        vt = shard_logical(vt, ("batch", "kv_heads", "seq", "head_dim"))
-        out = _sharded_flash(config, qt, kt, vt)
+        out = bhsd_flash_attention(config, qt, kt, vt)
         x = x + jnp.einsum("bhsk,hkd->bsd", out,
                            p["wo"].astype(dtype).reshape(h, hd, D))
     else:

@@ -63,7 +63,10 @@ class IdMapper:
                 if slot is None:
                     slot = self._free.pop()
                     self._slot_of[raw] = slot
-                    self._freq[raw] = 0
+                    # setdefault: a demoted id returning from a host
+                    # tier keeps its frequency history (evict_ids
+                    # retains it for exactly this)
+                    self._freq.setdefault(raw, 0)
                 if count:
                     self._freq[raw] += 1
                 out[i] = slot
@@ -118,10 +121,12 @@ class IdMapper:
                 raw for raw, f in self._freq.items() if f < threshold
             ]
             for raw in cold:
-                slot = self._slot_of.pop(raw)
+                # host-tier ids track frequency without holding a slot
+                slot = self._slot_of.pop(raw, None)
                 del self._freq[raw]
-                self._free.append(slot)
-                freed.append(slot)
+                if slot is not None:
+                    self._free.append(slot)
+                    freed.append(slot)
         if freed:
             logger.info("evicted %d cold ids", len(freed))
         return freed
@@ -375,6 +380,17 @@ class TieredKvEmbedding(KvEmbedding):
                 with self.mapper._lock:
                     self.mapper._freq[raw] = int(np.asarray(freqs)[i])
         return table
+
+    def evict(self, table, threshold: int):
+        """Drop cold ids from BOTH tiers (host rows freed too)."""
+        with self.mapper._lock:
+            cold_host = [
+                raw for raw in list(self._host_store)
+                if self.mapper._freq.get(raw, 0) < threshold
+            ]
+        for raw in cold_host:
+            self._host_store.pop(raw, None)
+        return super().evict(table, threshold)
 
     def state_dict(self) -> dict:
         return {

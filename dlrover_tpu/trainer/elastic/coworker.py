@@ -37,23 +37,41 @@ from dlrover_tpu.common.rpc import RpcClient, RpcServer, RpcService
 logger = get_logger(__name__)
 
 
+EOF_BATCH = {"__dlrtpu_coworker_eof__": True}
+
+
+def _is_eof(batch) -> bool:
+    return isinstance(batch, dict) and batch.get(
+        "__dlrtpu_coworker_eof__", False
+    )
+
+
 class _BatchQueueService(RpcService):
     """``get`` pops one preprocessed batch (blocking with timeout)."""
 
-    def __init__(self, batch_queue: "queue.Queue", stats: dict):
+    def __init__(self, batch_queue: "queue.Queue", stats: dict,
+                 drained: threading.Event):
         self._queue = batch_queue
         self._stats = stats
+        self._drained = drained
 
     def get(self, node_type, node_id, message):
         timeout = 30.0
         if isinstance(message, dict):
             timeout = float(message.get("timeout", 30.0))
+        # a dead feeder (crashed or exhausted iterator) with an empty
+        # queue will never produce again: tell the trainer so it drops
+        # this coworker instead of recycling its announcements forever
+        if self._drained.is_set() and self._queue.empty():
+            return dict(EOF_BATCH)
         # block strictly less than the caller's socket deadline, or an
         # empty queue would always surface as a client-side socket
         # timeout (and blacklist a healthy coworker)
         try:
             batch = self._queue.get(timeout=max(1.0, timeout - 5.0))
         except queue.Empty:
+            if self._drained.is_set():
+                return dict(EOF_BATCH)
             return None
         self._stats["served"] = self._stats.get("served", 0) + 1
         return batch
@@ -83,8 +101,10 @@ class CoworkerDataService:
         self._iterator_fn = iterator_fn
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self.stats: dict = {"produced": 0, "served": 0}
+        self._drained = threading.Event()
         self._server = RpcServer(
-            port, _BatchQueueService(self._queue, self.stats)
+            port, _BatchQueueService(self._queue, self.stats,
+                                     self._drained)
         )
         self._announce_to = announce_to
         self._announce_every = max(1, int(announce_every))
@@ -141,6 +161,8 @@ class CoworkerDataService:
                         )
         except Exception:  # noqa: BLE001 - user iterator crash
             logger.exception("coworker preprocessing iterator failed")
+        finally:
+            self._drained.set()
 
 
 class _DataInfoQueue(RpcService):
@@ -254,6 +276,12 @@ class CoworkerDataset:
                 if self._failures[addr] < self._max_failures:
                     # transient: keep the announcement's credit alive
                     _reannounce(ready)
+                continue
+            if _is_eof(batch):
+                # the coworker's producer is gone for good: blacklist
+                # and let its stale announcements drain
+                self._failures[addr] = self._max_failures
+                logger.info("coworker %s reports end of stream", addr)
                 continue
             if batch is None:
                 # momentarily empty queue — the credit is still good

@@ -90,3 +90,42 @@ class TestCoworkerDataPath:
         finally:
             cw_live.stop()
             info.stop()
+
+    def test_exhausted_iterator_reports_eof(self):
+        """A coworker whose (finite/crashed) iterator ends must not
+        recycle announcements forever: the server reports end-of-stream
+        once drained and the trainer blacklists it."""
+        info = DataInfoService()
+        info.start()
+
+        def finite():
+            def it():
+                for i in range(2):
+                    yield {"x": np.zeros((2, 2), np.float32),
+                           "tag": "finite"}
+            return it()
+
+        live = CoworkerDataService(
+            _producer("live"), announce_to=info.addr, announce_every=1,
+            queue_size=4,
+        )
+        done = CoworkerDataService(
+            finite, announce_to=info.addr, announce_every=1,
+            queue_size=4,
+        )
+        live.start()
+        done.start()
+        try:
+            ds = CoworkerDataset(
+                info.addr, n_batches=8, prefetch=1, fetch_timeout=8.0,
+            )
+            tags = [b["tag"] for b in ds]
+            assert len(tags) == 8
+            # the finite coworker contributed at most its 2 batches and
+            # then stopped being consulted
+            assert tags.count("finite") <= 2
+            assert tags.count("live") >= 6
+        finally:
+            live.stop()
+            done.stop()
+            info.stop()

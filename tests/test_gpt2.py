@@ -210,3 +210,24 @@ class TestElasticPsService:
         assert client.report_ps_version(1, "local")
         assert local_master.elastic_ps_service.all_workers_synced()
         client.close()
+
+
+def test_flash_einsum_path_matches_reference():
+    """The einsum-form flash branch (qkv direct to [B,H,S,Dh]) equals
+    the reference-softmax path."""
+    import dataclasses
+
+    from dlrover_tpu.models.gpt2 import GPT2Config, gpt2_apply, gpt2_init
+
+    cfg_ref = GPT2Config(
+        vocab_size=64, dim=32, n_layers=2, n_heads=4, max_seq_len=32,
+        mlp_dim=64, attn_impl="reference", dtype="float32",
+    )
+    cfg_flash = dataclasses.replace(cfg_ref, attn_impl="flash")
+    params = gpt2_init(cfg_ref, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 64)
+    ref = gpt2_apply(cfg_ref, params, tokens)
+    out = gpt2_apply(cfg_flash, params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=3e-4
+    )
