@@ -195,6 +195,7 @@ class DistributedJobMaster(JobMaster):
         # only meaningful when the scaler talks to a real API server.
         self.scaleplan_watcher = None
         k8s_client = getattr(scaler, "_client", None)
+        self._k8s_client = k8s_client
         if k8s_client is not None and hasattr(
             k8s_client, "list_custom_resources"
         ):
@@ -295,7 +296,29 @@ class DistributedJobMaster(JobMaster):
         )
         return self._exit_code
 
+    def _report_job_status(self):
+        """Patch the ElasticJob CR's status.phase so the operator stops
+        the job's pods (elasticjob_controller.go syncs the same field).
+        Best-effort: operator-less deployments have no CR."""
+        client = self._k8s_client
+        if client is None or not hasattr(
+            client, "update_custom_resource_status"
+        ):
+            return
+        phase = "Succeeded" if self._exit_code == 0 else "Failed"
+        try:
+            client.update_custom_resource_status(
+                "elasticjobs", self._job_args.job_name,
+                {"phase": phase, "reason": self._exit_reason},
+            )
+            logger.info("reported ElasticJob status %s", phase)
+        except Exception:  # noqa: BLE001 - no CR / no CRD installed
+            logger.info(
+                "no ElasticJob CR to update (operator-less run)"
+            )
+
     def stop(self):
+        self._report_job_status()
         self.metric_collector.stop()
         self.paral_generator.stop()
         if self.scaleplan_watcher is not None:

@@ -1,0 +1,121 @@
+"""Manual tensor-parallel annotation helper.
+
+Equivalent capability: the reference's manual-TP utilities
+(atorch/atorch/utils/manual_tp_utils.py — ``TPInfo`` with
+``shard_col``/``shard_row``/``shard_vocab`` declarations per module
+name, applied by swapping modules for Col/RowParallel layers).
+
+TPU redesign: there are no module swaps — tensor parallelism is a
+sharding annotation. :class:`TPInfo` collects the same three
+declarations keyed by parameter-path substrings and emits a logical-
+axes pytree for :func:`auto_accelerate` (or
+``shard_logical``-compatible tuples), so a user hand-sharding a custom
+model writes the familiar col/row/vocab vocabulary and the GSPMD
+partitioner inserts the same collectives Megatron's Linear layers
+issue by hand (all-gather for column outputs, reduce for row outputs).
+
+    tp = TPInfo()
+    tp.shard_col("wq", "wk", "wv", "w_gate", "w_up")
+    tp.shard_row("wo", "w_down")
+    tp.shard_vocab("embed", "lm_head")
+    axes = tp.build_axes(params)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = ["TPInfo"]
+
+# logical names DEFAULT_RULES maps onto the ``tensor`` mesh axis
+_COL = "mlp"      # output dim sharded  -> column parallel
+_ROW = "mlp"      # input dim sharded   -> row parallel
+_VOCAB = "vocab"
+
+
+class TPInfo:
+    """Collects col/row/vocab declarations and builds logical axes.
+
+    Declarations match parameters whose dotted tree path CONTAINS the
+    given name (the reference matches module-name prefixes the same
+    way). Column parallel shards the LAST dim, row parallel the FIRST
+    dim, vocab parallel the dim whose size equals ``vocab_size`` (or
+    the first dim when unspecified). Unmatched parameters get
+    replicated (all-None) axes — combine with your own tree for
+    fsdp-style defaults.
+    """
+
+    def __init__(self, vocab_size: Optional[int] = None):
+        self._col: list[str] = []
+        self._row: list[str] = []
+        self._vocab: list[str] = []
+        self._vocab_size = vocab_size
+
+    def shard_col(self, *names: str) -> "TPInfo":
+        self._col.extend(names)
+        return self
+
+    def shard_row(self, *names: str) -> "TPInfo":
+        self._row.extend(names)
+        return self
+
+    def shard_vocab(self, *names: str) -> "TPInfo":
+        self._vocab.extend(names)
+        return self
+
+    # ------------------------------------------------------------------
+
+    def _axes_for(self, path: str, ndim: int, shape) -> tuple:
+        axes: list = [None] * ndim
+        if ndim == 0:
+            return tuple(axes)
+        stacked = path.startswith("layers.") or ".layers." in path
+        lead = 1 if stacked and ndim > 1 else 0
+        if lead:
+            axes[0] = "layer"
+        if any(n in path for n in self._vocab):
+            dim = lead
+            if self._vocab_size is not None:
+                for d in range(lead, ndim):
+                    if shape[d] == self._vocab_size:
+                        dim = d
+                        break
+                else:
+                    raise ValueError(
+                        f"vocab-parallel param {path!r} has no dim of "
+                        f"size {self._vocab_size} (shape {tuple(shape)})"
+                        " — padded vocab? pass the padded size"
+                    )
+            axes[dim] = _VOCAB
+        elif any(n in path for n in self._col):
+            axes[ndim - 1] = _COL
+        elif any(n in path for n in self._row):
+            if ndim - lead >= 2:
+                axes[lead] = _ROW
+            else:
+                # 1-D row-parallel params (e.g. a row-linear bias) are
+                # replicated: the output dim is unsharded
+                pass
+        return tuple(axes)
+
+    def build_axes(self, params) -> dict:
+        """Logical-axes pytree for ``params`` (feeds auto_accelerate).
+
+        Parameters under a stacked ``layers`` subtree keep their
+        leading ``layer`` axis (pipe-shardable), mirroring the model
+        families' conventions.
+        """
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        axes_leaves = []
+        for path, leaf in flat:
+            name = ".".join(
+                str(getattr(e, "key", getattr(e, "idx", e)))
+                for e in path
+            )
+            shape = getattr(leaf, "shape", ())
+            axes_leaves.append(
+                self._axes_for(name, len(shape), shape)
+            )
+        return jax.tree_util.tree_unflatten(treedef, axes_leaves)

@@ -171,6 +171,17 @@ class RestK8sClient:
             pass
         return True
 
+    def update_custom_resource_status(
+        self, plural: str, name: str, status: dict
+    ) -> bool:
+        """Replace a CR's status subresource (PUT .../{name}/status)."""
+        with self._request(
+            "PUT", f"{self._crd_path(plural)}/{name}/status",
+            body={"status": status},
+        ):
+            pass
+        return True
+
     def delete_custom_resource(self, plural: str, name: str) -> bool:
         try:
             with self._request(

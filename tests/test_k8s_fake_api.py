@@ -27,7 +27,8 @@ class FakeK8sApi:
 
     def __init__(self):
         self.pods: dict[str, dict] = {}
-        self.crs: dict[str, dict] = {}   # scaleplan CRs by name
+        # custom resources: plural -> {name: manifest}
+        self.crs: dict[str, dict] = {"scaleplans": {}, "elasticjobs": {}}
         self.events: list[dict] = []
         self.cond = threading.Condition()
         self.server = None
@@ -98,25 +99,53 @@ class FakeK8sApi:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _cr_plural(self):
+                if "/apis/" not in self.path:
+                    return None
+                parts = urllib.parse.urlparse(self.path).path.split("/")
+                for plural in api.crs:
+                    if plural in parts:
+                        return plural
+                return None
+
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", "0"))
                 obj = json.loads(self.rfile.read(n).decode())
-                if "/scaleplans" in self.path:
+                plural = self._cr_plural()
+                if plural:
                     with api.cond:
                         api._rv += 1
                         obj.setdefault("metadata", {})[
                             "resourceVersion"] = str(api._rv)
-                        api.crs[obj["metadata"]["name"]] = obj
+                        api.crs[plural][obj["metadata"]["name"]] = obj
                     self._json(201, obj)
                     return
                 api.create(obj)
                 self._json(201, obj)
 
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                obj = json.loads(self.rfile.read(n).decode())
+                plural = self._cr_plural()
+                parts = urllib.parse.urlparse(self.path).path.split("/")
+                if plural and parts[-1] == "status":
+                    name = parts[-2]
+                    with api.cond:
+                        cr = api.crs[plural].get(name)
+                        if cr is None:
+                            self._json(404, {"status": "Failure"})
+                            return
+                        cr["status"] = obj.get("status", {})
+                    self._json(200, cr)
+                    return
+                self._json(404, {"status": "Failure"})
+
             def do_DELETE(self):
                 name = self.path.rsplit("/", 1)[-1]
-                if "/scaleplans/" in self.path:
+                plural = self._cr_plural()
+                if plural:
                     with api.cond:
-                        found = api.crs.pop(name, None)
+                        found = api.crs[plural].pop(name, None)
                     self._json(
                         200 if found else 404,
                         {"status": "Success" if found else "Failure"},
@@ -131,10 +160,11 @@ class FakeK8sApi:
                 parsed = urllib.parse.urlparse(self.path)
                 q = urllib.parse.parse_qs(parsed.query)
                 selector = q.get("labelSelector", [""])[0]
-                if "/scaleplans" in parsed.path:
+                plural = self._cr_plural()
+                if plural:
                     with api.cond:
                         items = [
-                            c for c in api.crs.values()
+                            c for c in api.crs[plural].values()
                             if api._matches(c, selector)
                         ]
                     self._json(200, {"items": items})
@@ -369,7 +399,7 @@ class TestScalePlanWatcher:
             # pods for the whole group
             assert _wait(lambda: len(api.pods) == 3), api.pods
             # the CR is deleted as the apply acknowledgement
-            assert api.crs == {}
+            assert api.crs["scaleplans"] == {}
             # re-polling must not re-apply
             assert watcher.poll_once() == 0
         finally:
@@ -391,3 +421,70 @@ class TestScalePlanWatcher:
         client.create_custom_resource("scaleplans", manifest)
         assert watcher.poll_once() == 0
         assert applied == []
+
+
+class TestElasticJobOperator:
+    """The Python reconciler (reference elasticjob_controller.go): an
+    ElasticJob CR materialises a master pod; completion stops pods; a
+    deleted CR garbage-collects them."""
+
+    def _submit_job(self, client, name, workers=2):
+        from dlrover_tpu.scheduler.crd import ElasticJobSpec, ReplicaSpec
+
+        spec = ElasticJobSpec(
+            job_name=name,
+            replica_specs={"worker": ReplicaSpec(replicas=workers)},
+        )
+        assert client.create_custom_resource(
+            "elasticjobs", spec.to_manifest()
+        )
+
+    def test_job_cr_creates_master_pod(self, fake_api):
+        from dlrover_tpu.scheduler.operator import ElasticJobOperator
+
+        api, url = fake_api
+        client = RestK8sClient(base_url=url)
+        self._submit_job(client, "jobA", workers=3)
+        op = ElasticJobOperator(client)
+        actions = op.reconcile_once()
+        assert actions["created"] == 1
+        assert "jobA-master" in api.pods
+        pod = api.pods["jobA-master"]
+        assert pod["metadata"]["labels"]["elasticjob-name"] == "jobA"
+        assert "--node_num" in pod["spec"]["command"]
+        idx = pod["spec"]["command"].index("--node_num")
+        assert pod["spec"]["command"][idx + 1] == "3"
+        # level-based: a second sweep is a no-op
+        assert op.reconcile_once()["created"] == 0
+
+    def test_finished_job_stops_pods(self, fake_api):
+        from dlrover_tpu.scheduler.operator import ElasticJobOperator
+
+        api, url = fake_api
+        client = RestK8sClient(base_url=url)
+        self._submit_job(client, "jobB")
+        op = ElasticJobOperator(client)
+        op.reconcile_once()
+        assert "jobB-master" in api.pods
+        # the job finishes: the master patches the CR status through
+        # the API (same verb DistributedJobMaster uses on exit)
+        assert client.update_custom_resource_status(
+            "elasticjobs", "jobB", {"phase": "Succeeded"}
+        )
+        actions = op.reconcile_once()
+        assert actions["stopped"] >= 1
+        assert "jobB-master" not in api.pods
+
+    def test_deleted_cr_garbage_collects_pods(self, fake_api):
+        from dlrover_tpu.scheduler.operator import ElasticJobOperator
+
+        api, url = fake_api
+        client = RestK8sClient(base_url=url)
+        self._submit_job(client, "jobC")
+        op = ElasticJobOperator(client)
+        op.reconcile_once()
+        assert "jobC-master" in api.pods
+        assert client.delete_custom_resource("elasticjobs", "jobC")
+        actions = op.reconcile_once()
+        assert actions["gc"] >= 1
+        assert "jobC-master" not in api.pods
