@@ -284,6 +284,9 @@ class DistributedJobMaster(JobMaster):
                     self._exit_code = 0
                     self._exit_reason = JobExitReason.SUCCEEDED
                     break
+            # reached only through a conclusive break above — an
+            # interrupt must NOT report a job phase to the operator
+            self._job_concluded = True
         except KeyboardInterrupt:
             pass
         finally:
@@ -304,6 +307,11 @@ class DistributedJobMaster(JobMaster):
         if client is None or not hasattr(
             client, "update_custom_resource_status"
         ):
+            return
+        if not getattr(self, "_job_concluded", False):
+            # interrupted mid-run (eviction/SIGINT): the job did NOT
+            # finish — reporting Succeeded would make the operator tear
+            # down a job that should be relaunched
             return
         phase = "Succeeded" if self._exit_code == 0 else "Failed"
         try:

@@ -199,9 +199,16 @@ class PodScaler:
                     "rank-index": str(node.rank_index),
                 },
             },
-            "spec": dict(self._pod_template),
+            # real API servers require spec.containers[]; the template
+            # is the main-container template (image/command/resources)
+            "spec": {
+                "restartPolicy": "Never",
+                "containers": [dict(self._pod_template)],
+            },
         }
-        env = spec["spec"].setdefault("env", [])
+        container = spec["spec"]["containers"][0]
+        container.setdefault("name", "main")
+        env = container.setdefault("env", [])
         env.extend(
             [
                 {"name": NodeEnv.NODE_ID, "value": str(node.id)},

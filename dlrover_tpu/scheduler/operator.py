@@ -58,10 +58,33 @@ def build_master_pod(manifest: dict,
     job_name = spec.job_name or meta.get("name", "")
     master_spec = spec.replica_specs.get("master")
     image = getattr(master_spec, "image", "") or master_image
+    resources = {}
+    if master_spec is not None:
+        if getattr(master_spec, "cpu", 0):
+            resources["cpu"] = master_spec.cpu
+        if getattr(master_spec, "memory_mb", 0):
+            resources["memory"] = f"{master_spec.memory_mb}Mi"
     node_num = 0
     worker_spec = spec.replica_specs.get("worker")
     if worker_spec is not None:
         node_num = int(getattr(worker_spec, "replicas", 0) or 0)
+    container = {
+        "name": "main",
+        "image": image,
+        "command": DEFAULT_MASTER_COMMAND + [
+            "--job_name", job_name,
+            "--node_num", str(node_num),
+        ],
+        "env": [
+            {"name": "DLROVER_TPU_JOB_NAME", "value": job_name},
+            {"name": "DLROVER_TPU_NAMESPACE",
+             "value": meta.get("namespace", "default")},
+        ],
+    }
+    if resources:
+        container["resources"] = {
+            "requests": resources, "limits": resources,
+        }
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -76,16 +99,7 @@ def build_master_pod(manifest: dict,
         },
         "spec": {
             "restartPolicy": "Never",
-            "image": image,
-            "command": DEFAULT_MASTER_COMMAND + [
-                "--job_name", job_name,
-                "--node_num", str(node_num),
-            ],
-            "env": [
-                {"name": "DLROVER_TPU_JOB_NAME", "value": job_name},
-                {"name": "DLROVER_TPU_NAMESPACE",
-                 "value": meta.get("namespace", "default")},
-            ],
+            "containers": [container],
         },
     }
 
@@ -108,6 +122,10 @@ class ElasticJobOperator:
         self._master_image = master_image
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # jobs this operator instance has seen as CRs: GC also covers
+        # a job whose managed master pod is already gone (workers
+        # alone carry no managed-by label)
+        self._managed_jobs: set[str] = set()
 
     # ---------------------------------------------------------- sweeps
 
@@ -128,6 +146,7 @@ class ElasticJobOperator:
             if job:
                 by_job.setdefault(job, []).append(d)
 
+        self._managed_jobs.update(jobs)
         for job_name, manifest in jobs.items():
             phase = (manifest.get("status", {}) or {}).get("phase", "")
             job_pods = by_job.get(job_name, [])
@@ -159,7 +178,7 @@ class ElasticJobOperator:
         for job_name, job_pods in by_job.items():
             if job_name in jobs:
                 continue
-            managed = any(
+            managed = job_name in self._managed_jobs or any(
                 p.get("metadata", {}).get("labels", {}).get(
                     MANAGED_BY_LABEL) == MANAGED_BY
                 for p in job_pods

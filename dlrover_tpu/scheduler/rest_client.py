@@ -127,7 +127,7 @@ class RestK8sClient:
     # ------------------------------------------------------------- http
 
     def _request(self, method: str, path: str, body=None, query=None,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, content_type: str | None = None):
         url = self.base_url + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -135,7 +135,9 @@ class RestK8sClient:
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Accept", "application/json")
         if data is not None:
-            req.add_header("Content-Type", "application/json")
+            req.add_header(
+                "Content-Type", content_type or "application/json"
+            )
         token = self._token
         if token is None and self._token_file:
             with open(self._token_file) as f:
@@ -174,10 +176,14 @@ class RestK8sClient:
     def update_custom_resource_status(
         self, plural: str, name: str, status: dict
     ) -> bool:
-        """Replace a CR's status subresource (PUT .../{name}/status)."""
+        """Merge-patch a CR's status subresource. PATCH with
+        application/merge-patch+json is what real API servers accept
+        for a partial {"status": ...} body (a PUT replace would demand
+        the full object + resourceVersion)."""
         with self._request(
-            "PUT", f"{self._crd_path(plural)}/{name}/status",
+            "PATCH", f"{self._crd_path(plural)}/{name}/status",
             body={"status": status},
+            content_type="application/merge-patch+json",
         ):
             pass
         return True

@@ -54,6 +54,7 @@ def _cleanup_job_shm(job):
      "--seq-len", "64", "--batch-size", "4", "--save-steps", "5"],
     ["examples/kv_ctr_train.py", "--steps", "50"],
     ["examples/ppo_rlhf.py", "--iterations", "3"],
+    ["examples/coworker_pipeline.py"],
 ])
 def test_example_runs(args, tmp_path):
     # per-test job name: the subprocesses' persistent checkpoint/timer

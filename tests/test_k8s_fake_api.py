@@ -123,6 +123,9 @@ class FakeK8sApi:
                 api.create(obj)
                 self._json(201, obj)
 
+            def do_PATCH(self):
+                self.do_PUT()
+
             def do_PUT(self):
                 n = int(self.headers.get("Content-Length", "0"))
                 obj = json.loads(self.rfile.read(n).decode())
@@ -291,7 +294,7 @@ class TestSchedulerAgainstFakeApi:
             # pod spec carries the node env contract
             envs = {
                 e["name"]: e["value"]
-                for e in api.pods["job1-worker-0"]["spec"]["env"]
+                for e in api.pods["job1-worker-0"]["spec"]["containers"][0]["env"]
             }
             from dlrover_tpu.common.constants import NodeEnv
 
@@ -451,9 +454,9 @@ class TestElasticJobOperator:
         assert "jobA-master" in api.pods
         pod = api.pods["jobA-master"]
         assert pod["metadata"]["labels"]["elasticjob-name"] == "jobA"
-        assert "--node_num" in pod["spec"]["command"]
-        idx = pod["spec"]["command"].index("--node_num")
-        assert pod["spec"]["command"][idx + 1] == "3"
+        command = pod["spec"]["containers"][0]["command"]
+        assert "--node_num" in command
+        assert command[command.index("--node_num") + 1] == "3"
         # level-based: a second sweep is a no-op
         assert op.reconcile_once()["created"] == 0
 
