@@ -1074,8 +1074,10 @@ def flash_attention_bshd(
     - ``fused=True`` (default): blocks span the full H*Dh minor dim and
       the head loop is unrolled inside the kernel — all HBM traffic is
       contiguous, each kv block feeds every q head, mask built once per
-      tile. VMEM scales with H*Dh; the 512-row default blocks fit a
-      2048-wide minor dim comfortably.
+      tile. VMEM scales with H*Dh, so block sizes are clamped to a
+      width-dependent budget (512-row forward / 256-row backward at a
+      1024-wide minor dim, halving as the width doubles) — a warning
+      logs when user knobs are reduced.
     - ``fused=False``: per-head grid; each head is a tile-aligned
       128-lane column block (strided HBM reads — mainly an ablation
       reference).
@@ -1109,6 +1111,34 @@ def flash_attention_bshd(
             bwd_block_k=bwd_block_k, interpret=interpret,
         )
         return o.transpose(0, 2, 1, 3)
+    if fused:
+        # The fused kernels' VMEM footprint scales with the full H*Dh
+        # minor width (double-buffered q/k/v/do blocks + f32
+        # accumulator slabs + per-head [bq, bk] temporaries that Mosaic
+        # keeps live across the unrolled head loop). Measured ceiling
+        # on v5e at width 1024: the forward fits at 512-row blocks and
+        # the backward at 256 (block knobs tuned for the per-head
+        # kernels — where 1024x1024 is optimal — OOM the fused family,
+        # verified on-chip). Clamp to the budget, tile-aligned.
+        width = H * hd
+        cap = max(128, ((512 * 1024) // max(width, 1024)) // 128 * 128)
+        bcap = max(128, cap // 2)
+        clamped = (
+            min(block_q, cap), min(block_k, cap),
+            min(bwd_block_q or block_q, bcap),
+            min(bwd_block_k or block_k, bcap),
+        )
+        requested = (block_q, block_k, bwd_block_q or block_q,
+                     bwd_block_k or block_k)
+        if clamped != requested:
+            from dlrover_tpu.common.log import get_logger
+
+            get_logger(__name__).warning(
+                "fused bshd kernels: blocks %s clamped to %s for the "
+                "%d-wide minor dim (VMEM budget)", requested, clamped,
+                width,
+            )
+        block_q, block_k, bwd_block_q, bwd_block_k = clamped
     o3 = _flash(
         q.reshape(B, S, H * hd), k.reshape(B, Skv, KVH * hd),
         v.reshape(B, Skv, KVH * hd), "bshdf" if fused else "bshd",
