@@ -367,8 +367,25 @@ class AsyncCheckpointSaver:
             self.local_shard_num,
         )
 
-    def stop(self):
+    def stop(self, join_timeout: float = 10.0):
         self._stopped.set()
+        # wake event threads blocked in q.get so the join is immediate,
+        # then bound-join: callers may delete the checkpoint dir right
+        # after stop(), and an in-flight persist must not recreate it.
+        for q in self._event_queues:
+            try:
+                q.put(None)
+            except Exception:  # noqa: BLE001
+                pass
+        deadline = time.time() + join_timeout
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(max(0.0, deadline - time.time()))
+                if t.is_alive():
+                    logger.warning(
+                        "saver thread %s still persisting after stop(); "
+                        "checkpoint dir must not be deleted yet", t.name
+                    )
 
     @classmethod
     def register_signal_handlers(cls):
@@ -461,6 +478,8 @@ class AsyncCheckpointSaver:
             except Exception:  # noqa: BLE001
                 time.sleep(1)
                 continue
+            if event is None:
+                continue  # stop() wake-up sentinel
             if event.storage_type == "memory":
                 continue  # shm-only checkpoint; nothing to persist
             try:

@@ -308,6 +308,24 @@ class PersistentSharedMemory(shared_memory.SharedMemory):
             # numpy views may still reference the buffer; leave mapping.
             pass
 
+    def unlink(self):
+        # The inherited unlink() unregisters from the resource tracker,
+        # but __init__ already did — the unmatched unregister makes the
+        # tracker process KeyError at interpreter exit. Re-register just
+        # before so the pair balances; roll back if the segment is gone.
+        try:
+            resource_tracker.register(self._name, "shared_memory")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            super().unlink()
+        except FileNotFoundError:
+            try:
+                resource_tracker.unregister(self._name, "shared_memory")
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+
 
 def get_or_create_shm(name: str, size: int = 0) -> PersistentSharedMemory:
     """Attach to shm ``name`` if it exists, else create it with ``size``.

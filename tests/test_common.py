@@ -143,6 +143,30 @@ class TestIpc:
         shm2.close()
         shm2.unlink()
 
+    def test_unlink_leaves_tracker_silent(self):
+        """create→unlink cycles (incl. the grow-recreate path) must not
+        emit resource_tracker KeyError tracebacks at interpreter exit."""
+        import subprocess
+        import sys
+
+        pid = os.getpid()
+        code = (
+            "from dlrover_tpu.common.ipc import get_or_create_shm\n"
+            f"s = get_or_create_shm('trk_probe_a{pid}', 4096)\n"
+            "s.close(); s.unlink()\n"
+            f"a = get_or_create_shm('trk_probe_b{pid}', 1024)\n"
+            f"b = get_or_create_shm('trk_probe_b{pid}', 8192)\n"
+            "a.close(); b.close(); b.unlink()\n"
+            "print('ok')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=60,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        assert proc.stdout.strip() == "ok"
+        assert proc.stderr == "", proc.stderr
+
 
 class TestStorage:
     def test_write_read_commit(self, tmp_path):
