@@ -14,11 +14,15 @@ model writes the familiar col/row/vocab vocabulary and the GSPMD
 partitioner inserts the same collectives Megatron's Linear layers
 issue by hand (all-gather for column outputs, reduce for row outputs).
 
-    tp = TPInfo()
+    tp = TPInfo(vocab_size=32000)
     tp.shard_col("wq", "wk", "wv", "w_gate", "w_up")
     tp.shard_row("wo", "w_down")
     tp.shard_vocab("embed", "lm_head")
     axes = tp.build_axes(params)
+
+``vocab_size`` is required when vocab-parallel params are 2-D: a
+``(vocab, dim)`` embed and a ``(dim, vocab)`` lm_head cannot be told
+apart by shape alone, so ``build_axes`` refuses to guess.
 """
 
 from __future__ import annotations
@@ -41,10 +45,11 @@ class TPInfo:
     Declarations match parameters whose dotted tree path CONTAINS the
     given name (the reference matches module-name prefixes the same
     way). Column parallel shards the LAST dim, row parallel the FIRST
-    dim, vocab parallel the dim whose size equals ``vocab_size`` (or
-    the first dim when unspecified). Unmatched parameters get
-    replicated (all-None) axes — combine with your own tree for
-    fsdp-style defaults.
+    dim, vocab parallel the dim whose size equals ``vocab_size``
+    (required for 2-D vocab params — embed vs lm_head orientation is
+    ambiguous without it; a 1-D vocab-length bias shards its only
+    dim). Unmatched parameters get replicated (all-None) axes —
+    combine with your own tree for fsdp-style defaults.
     """
 
     def __init__(self, vocab_size: Optional[int] = None):
@@ -76,7 +81,6 @@ class TPInfo:
         if lead:
             axes[0] = "layer"
         if any(n in path for n in self._vocab):
-            dim = lead
             if self._vocab_size is not None:
                 for d in range(lead, ndim):
                     if shape[d] == self._vocab_size:
@@ -88,6 +92,18 @@ class TPInfo:
                         f"size {self._vocab_size} (shape {tuple(shape)})"
                         " — padded vocab? pass the padded size"
                     )
+            elif ndim - lead >= 2:
+                # (vocab, dim) embeds and (dim, vocab) lm_heads are
+                # indistinguishable by shape alone — guessing the first
+                # dim silently mis-shards lm_head, so refuse instead
+                raise ValueError(
+                    f"vocab-parallel param {path!r} is ambiguous "
+                    f"(shape {tuple(shape)}): pass "
+                    "TPInfo(vocab_size=...) so the vocab dim can be "
+                    "identified"
+                )
+            else:
+                dim = lead  # 1-D (a vocab-length bias): only choice
             axes[dim] = _VOCAB
         elif any(n in path for n in self._col):
             axes[ndim - 1] = _COL

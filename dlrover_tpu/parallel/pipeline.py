@@ -1063,30 +1063,49 @@ def _interleaved_tables(S: int, V: int, M: int):
         k: np.asarray(rows[k], dtype=np.int32) for k in rows
     }
     # ring depth: smallest R where concurrently-live microbatches of a
-    # chunk never collide mod R (validated by replay)
+    # chunk never collide mod R in EITHER mailbox (validated by replay:
+    # inbuf saved-input slots AND cotbuf cotangent slots — a collision
+    # in either silently corrupts gradients in the table machine)
     R = max(peak, 1)
     while R <= M:
         ok = True
         live_slots = [
             {v: {} for v in range(V)} for _ in range(S)
         ]
+        cot_slots = [
+            {v: {} for v in range(V)} for _ in range(S)
+        ]
         for tt in range(T):
             for s in range(S):
-                bm, bv = tables["bm"][tt][s], tables["bv"][tt][s]
-                if bm >= 0:
-                    live_slots[s][bv].pop(bm % R, None)
-                fm, fv = tables["fm"][tt][s], tables["fv"][tt][s]
-                if fm >= 0:
-                    slot = fm % R
-                    if live_slots[s][fv].get(slot, fm) != fm:
+                # cotangent mailbox: the delivery (_buf_set step 1)
+                # lands BEFORE this tick's bwd read (step 3), so a
+                # differing occupant is corruption even when the
+                # occupant is consumed later this same tick
+                rbm, rbv = tables["rbm"][tt][s], tables["rbv"][tt][s]
+                if rbm >= 0:
+                    slot = rbm % R
+                    if cot_slots[s][rbv].get(slot, rbm) != rbm:
                         ok = False
-                    live_slots[s][fv][slot] = fm
+                    cot_slots[s][rbv][slot] = rbm
                 rfm, rfv = tables["rfm"][tt][s], tables["rfv"][tt][s]
                 if rfm >= 0:
                     slot = rfm % R
                     if live_slots[s][rfv].get(slot, rfm) != rfm:
                         ok = False
                     live_slots[s][rfv][slot] = rfm
+                fm, fv = tables["fm"][tt][s], tables["fv"][tt][s]
+                if fm >= 0:
+                    slot = fm % R
+                    if live_slots[s][fv].get(slot, fm) != fm:
+                        ok = False
+                    live_slots[s][fv][slot] = fm
+                # bwd reads (step 3) come AFTER this tick's deliveries
+                # and the fwd saved-input write — pop only after every
+                # write was collision-checked against the live occupant
+                bm, bv = tables["bm"][tt][s], tables["bv"][tt][s]
+                if bm >= 0:
+                    live_slots[s][bv].pop(bm % R, None)
+                    cot_slots[s][bv].pop(bm % R, None)
             if not ok:
                 break
         if ok:

@@ -112,6 +112,21 @@ class RestK8sClient:
                 # bound SA tokens rotate on disk (kubelet) — remember
                 # the path, re-read per request
                 self._token_file = token_file
+        elif (
+            explicit_endpoint
+            and token is None
+            and self.base_url.startswith("https")
+            and os.path.exists(os.path.join(_SA_DIR, "token"))
+        ):
+            # make the deliberate auth hardening diagnosable: a secured
+            # apiserver reached via DLROVER_TPU_K8S_API now returns
+            # 401/403 unless the SA token is explicitly opted in
+            logger.info(
+                "explicit https endpoint %s used without credentials; "
+                "the mounted service-account token is NOT auto-attached "
+                "— pass token= or set DLROVER_TPU_K8S_SA_TOKEN=1 to "
+                "authenticate", self.base_url,
+            )
         self._token = token
         self._ssl_ctx = None
         if self.base_url.startswith("https"):

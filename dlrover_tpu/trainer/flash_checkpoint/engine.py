@@ -667,6 +667,16 @@ class CheckpointEngine:
                     f"target expects {want_shape} — refusing a silent "
                     f"mismatched restore (stale or foreign checkpoint?)"
                 )
+            want_dtype = getattr(leaf_t, "dtype", None)
+            got_dtype = np.dtype(pieces[0][0].dtype)
+            if want_dtype is not None and got_dtype != np.dtype(
+                want_dtype
+            ):
+                raise ValueError(
+                    f"checkpoint leaf {name} has dtype {got_dtype}, "
+                    f"target expects {np.dtype(want_dtype)} — refusing "
+                    f"a silent mismatched-dtype restore"
+                )
             arr = _restore_leaf_to_sharding(pieces, leaf_t, read_box)
             if arr is None:
                 host = _assemble_one(pieces, read_box)
@@ -913,6 +923,15 @@ def _fill_target(state: dict, target, step: int):
                 f"checkpoint leaf {name} has shape {tuple(arr.shape)}, "
                 f"target expects {want_shape} — refusing a silent "
                 f"mismatched restore (stale or foreign checkpoint?)"
+            )
+        want_dtype = getattr(leaf, "dtype", None)
+        if want_dtype is not None and np.dtype(arr.dtype) != np.dtype(
+            want_dtype
+        ):
+            raise ValueError(
+                f"checkpoint leaf {name} has dtype {arr.dtype}, target "
+                f"expects {np.dtype(want_dtype)} — refusing a silent "
+                f"mismatched-dtype restore"
             )
         if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
             arr = jax.device_put(arr, leaf.sharding)

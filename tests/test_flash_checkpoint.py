@@ -303,6 +303,15 @@ class TestReviewFixes:
             engine.load(target={"w": jnp.zeros((8, 8))})
         engine.close()
 
+    def test_dtype_mismatch_raises(self, tmp_path):
+        """Same refusal as the shape path: a saved fp32 leaf must not
+        silently restore into a bf16 target (ADVICE r3)."""
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        engine.save_to_memory(1, {"w": jnp.ones((4,), jnp.float32)})
+        with pytest.raises(ValueError, match="dtype"):
+            engine.load(target={"w": jnp.zeros((4,), jnp.bfloat16)})
+        engine.close()
+
 
 class TestAsyncSave:
     def test_async_save_matches_sync(self, tmp_path):

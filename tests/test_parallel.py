@@ -182,6 +182,22 @@ class TestManualTP:
         # unmatched params replicate
         assert axes["final_norm"] == (None,)
 
+    def test_vocab_without_size_refuses_ambiguous_2d(self):
+        """Without vocab_size, a 2-D vocab param is ambiguous ((vocab,d)
+        embed vs (d,vocab) lm_head) — must raise, not guess (ADVICE r3);
+        a 1-D vocab-length bias still shards its only dim."""
+        import pytest
+
+        from dlrover_tpu.parallel.manual_tp import TPInfo
+
+        params = {"lm_head": np.zeros((32, 64)), "bias": np.zeros((64,))}
+        tp = TPInfo().shard_vocab("lm_head")
+        with pytest.raises(ValueError, match="ambiguous"):
+            tp.build_axes(params)
+        tp1 = TPInfo().shard_vocab("bias")
+        axes = tp1.build_axes({"bias": np.zeros((64,))})
+        assert axes["bias"] == ("vocab",)
+
     def test_manual_tp_trains(self):
         """The emitted axes drive a real TP train step."""
         import optax

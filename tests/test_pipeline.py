@@ -535,6 +535,52 @@ class TestInterleaved1F1B:
             np.asarray(jax.device_get(gi["lm_head"])),
             np.asarray(gd["lm_head"]), rtol=5e-3, atol=1e-5)
 
+    def test_interleaved_ring_depth_collision_free_both_mailboxes(self):
+        """Independent replay oracle: with the returned R, neither the
+        saved-input mailbox (inbuf) nor the cotangent mailbox (cotbuf)
+        ever overwrites a delivered-but-unconsumed entry, across a sweep
+        wider than any empirical spot-check (ADVICE r3: cotbuf was
+        previously unvalidated)."""
+        from dlrover_tpu.parallel.pipeline import _interleaved_tables
+
+        def replay(tables, T, R, S, V):
+            inb = [{v: {} for v in range(V)} for _ in range(S)]
+            cot = [{v: {} for v in range(V)} for _ in range(S)]
+            for tt in range(T):
+                for s in range(S):
+                    # tick order mirrors the machine: deliveries land
+                    # (step 1), then fwd writes its saved input, then
+                    # bwd consumes both mailboxes (step 3)
+                    rbm, rbv = tables["rbm"][tt][s], tables["rbv"][tt][s]
+                    if rbm >= 0:
+                        slot = rbm % R
+                        assert cot[s][rbv].get(slot, rbm) == rbm, (
+                            "cotbuf collision", S, V, tt, s, slot)
+                        cot[s][rbv][slot] = rbm
+                    rfm, rfv = tables["rfm"][tt][s], tables["rfv"][tt][s]
+                    if rfm >= 0:
+                        slot = rfm % R
+                        assert inb[s][rfv].get(slot, rfm) == rfm, (
+                            "inbuf rf collision", S, V, tt, s, slot)
+                        inb[s][rfv][slot] = rfm
+                    fm, fv = tables["fm"][tt][s], tables["fv"][tt][s]
+                    if fm >= 0:
+                        slot = fm % R
+                        assert inb[s][fv].get(slot, fm) == fm, (
+                            "inbuf fwd collision", S, V, tt, s, slot)
+                        inb[s][fv][slot] = fm
+                    bm, bv = tables["bm"][tt][s], tables["bv"][tt][s]
+                    if bm >= 0:
+                        inb[s][bv].pop(bm % R, None)
+                        cot[s][bv].pop(bm % R, None)
+
+        for S in (2, 3, 4, 6, 8):
+            for V in (2, 3, 4, 6):
+                for M in (S, 2 * S, 4 * S, 8 * S):
+                    tables, T, R = _interleaved_tables(S, V, M)
+                    assert R <= M
+                    replay(tables, T, R, S, V)
+
     def test_interleaved_bubble_smaller_than_plain(self):
         """At (pipe=4, M=8), V=2 chunks cost fewer thin-tick units than
         plain 1F1B (whose ticks do V x the work)."""
