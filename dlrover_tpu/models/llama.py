@@ -280,7 +280,20 @@ def _rope(x, positions, theta):
     return _rope_apply(x, cos, sin)
 
 
-def _sharded_flash(config: LlamaConfig, qt, kt, vt, layout: str = "bhsd"):
+def _maybe_full_rope(config, cos, sin):
+    """Duplicate the half-width tables to [B, S, Dh] when the einsum
+    flash path is active: rope is then applied INSIDE the Pallas kernels
+    (ops/attention.py _rope_tile), which removes the XLA-side rope
+    read-modify-write and pad/concat relayout passes (~16 ms/step on the
+    nano-350m profile). Done once outside the layer scan."""
+    if flash_einsum_path(config):
+        return (jnp.concatenate([cos, cos], -1),
+                jnp.concatenate([sin, sin], -1))
+    return cos, sin
+
+
+def _sharded_flash(config: LlamaConfig, qt, kt, vt, layout: str = "bhsd",
+                   rope_cos=None, rope_sin=None):
     """pallas_call does not auto-partition under GSPMD: without an explicit
     shard_map, jit would all-gather q/k/v to run the kernel replicated.
     Map the kernel over the mesh's batch/head axes (seq stays local here —
@@ -294,15 +307,21 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt, layout: str = "bhsd"):
     from dlrover_tpu.parallel.sharding import logical_to_mesh_axes
 
     fa = flash_attention if layout == "bhsd" else flash_attention_bshd
+    rope = rope_cos is not None
 
-    def kernel(q, k, v):
+    def kernel(q, k, v, *tables):
+        extra = (
+            {"rope_cos": tables[0], "rope_sin": tables[1]} if rope else {}
+        )
         return fa(
             q, k, v, causal=True,
             block_q=config.attn_block_q, block_k=config.attn_block_k,
             bwd_block_q=config.attn_bwd_block_q,
             bwd_block_k=config.attn_bwd_block_k,
+            **extra,
         )
 
+    tables = (rope_cos, rope_sin) if rope else ()
     try:
         mesh = get_mesh()
     except RuntimeError:
@@ -310,7 +329,7 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt, layout: str = "bhsd"):
     if mesh is None or all(
         mesh.shape[a] == 1 for a in ("data", "fsdp", "tensor")
     ):
-        return kernel(qt, kt, vt)
+        return kernel(qt, kt, vt, *tables)
 
     rules = (
         ("batch", ("data", "fsdp")),
@@ -325,15 +344,19 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt, layout: str = "bhsd"):
         kv_axes = ("batch", None, "kv_heads", None)
     q_spec = logical_to_mesh_axes(q_axes, rules)
     kv_spec = logical_to_mesh_axes(kv_axes, rules)
+    in_specs = (q_spec, kv_spec, kv_spec)
+    if rope:
+        table_spec = logical_to_mesh_axes(("batch", None, None), rules)
+        in_specs = in_specs + (table_spec, table_spec)
     from dlrover_tpu.parallel import get_shard_map
 
     return get_shard_map()(
         kernel,
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec),
+        in_specs=in_specs,
         out_specs=q_spec,
         check_vma=False,
-    )(qt, kt, vt)
+    )(qt, kt, vt, *tables)
 
 
 def flash_einsum_path(config) -> bool:
@@ -347,12 +370,17 @@ def flash_einsum_path(config) -> bool:
     )
 
 
-def bhsd_flash_attention(config, qt, kt, vt):
-    """Shard + run the Pallas flash kernel on [B,H,S,Dh] operands."""
+def bhsd_flash_attention(config, qt, kt, vt, rope_cos=None, rope_sin=None):
+    """Shard + run the Pallas flash kernel on [B,H,S,Dh] operands.
+
+    With ``rope_cos``/``rope_sin`` (full-width [B,S,Dh] tables), rope is
+    fused into the kernels (q/k passed raw, dq/dk un-roped on the way
+    out)."""
     qt = shard_logical(qt, ("batch", "heads", "seq", "head_dim"))
     kt = shard_logical(kt, ("batch", "kv_heads", "seq", "head_dim"))
     vt = shard_logical(vt, ("batch", "kv_heads", "seq", "head_dim"))
-    return _sharded_flash(config, qt, kt, vt)
+    return _sharded_flash(config, qt, kt, vt, rope_cos=rope_cos,
+                          rope_sin=rope_sin)
 
 
 def _seq_axis_active() -> bool:
@@ -392,7 +420,11 @@ def _attention(config: LlamaConfig, q, k, v):
 
 
 def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
-    """One transformer block. x: [B,S,D]; rope tables [B,S,Dh/2]."""
+    """One transformer block. x: [B,S,D].
+
+    Rope tables are [B,S,Dh] FULL-width when ``flash_einsum_path``
+    holds (rope fuses into the kernels via _maybe_full_rope) and
+    [B,S,Dh/2] half-width otherwise (external _rope_apply*)."""
     p = layer_params
     dtype = x.dtype
     B, S, D = x.shape
@@ -410,9 +442,10 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
                         p["wk"].astype(dtype).reshape(D, kvh, hd))
         vt = jnp.einsum("bsd,dhk->bhsk", y,
                         p["wv"].astype(dtype).reshape(D, kvh, hd))
-        qt = _rope_apply_bhsd(qt, rope_cos, rope_sin)
-        kt = _rope_apply_bhsd(kt, rope_cos, rope_sin)
-        out = bhsd_flash_attention(config, qt, kt, vt)
+        # rope_cos/rope_sin are FULL-width here (_maybe_full_rope):
+        # rope applies inside the kernels, q/k stay raw
+        out = bhsd_flash_attention(
+            config, qt, kt, vt, rope_cos=rope_cos, rope_sin=rope_sin)
         x = x + jnp.einsum("bhsk,hkd->bsd", out,
                            p["wo"].astype(dtype).reshape(h, hd, D))
     else:
@@ -483,6 +516,7 @@ def llama_apply(config: LlamaConfig, params, tokens, positions=None,
     x = shard_logical(x, ("batch", "seq", "embed"))
     cos, sin = _rope_tables(
         positions, config.head_dim // 2, config.rope_theta, dtype)
+    cos, sin = _maybe_full_rope(config, cos, sin)
 
     from dlrover_tpu.parallel.pipeline import pipe_size, pipeline_apply
 
@@ -528,6 +562,7 @@ def _llama_1f1b_loss(config: LlamaConfig, params, tokens):
     x = shard_logical(x, ("batch", "seq", "embed"))
     cos, sin = _rope_tables(
         positions, config.head_dim // 2, config.rope_theta, dtype)
+    cos, sin = _maybe_full_rope(config, cos, sin)
 
     # Global valid-token normalizer, computed from the labels BEFORE the
     # schedule: per-microbatch normalization would weight tokens in

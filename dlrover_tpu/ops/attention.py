@@ -55,6 +55,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Row-stats (lse/delta) lane width. 8 was the minimum legal block, but
+# an 8-wide trailing dim is physically padded to 128 lanes anyway
+# (T(8,128) tiling): the stacked remat saves and the delta broadcast
+# paid 16x the logical bytes and sub-lane write masking. Full 128-wide
+# stats make every stats tensor dense: half the physical bytes, full-
+# bandwidth DUS/slice/broadcast.
+STATS_W = 128
+
 
 def _block_mask(shape, i, j, *, block_q, block_k, causal, q_len, kv_len):
     """Validity mask for a (block_q, block_k) score tile.
@@ -100,6 +108,53 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# ---------------------------------------------------------------------------
+# fused rope (rotary embedding applied inside the kernels)
+# ---------------------------------------------------------------------------
+#
+# rope(x) = x * C + (x @ P) * S, where C/S are the cos/sin tables
+# duplicated to full head width ([c, c] / [s, s]) and P is the
+# rotate-half permutation-with-sign matrix (x @ P == [-x2, x1]).
+# The matrix form avoids 64-lane slicing/concat — which Mosaic cannot
+# lower and XLA fuses badly (pad+maximum relayouts) — at the cost of a
+# tiny (block, Dh) @ (Dh, Dh) matmul that rides the MXU under the
+# kernel's VPU softmax chain. The transposed map for gradients is
+# unrope(g) = g * C - (g * S) @ P  (P^T == -P).
+
+
+def _rope_rot_mat(dh, dtype):
+    half = dh // 2
+    r = jax.lax.broadcasted_iota(jnp.int32, (dh, dh), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (dh, dh), 1)
+    p = jnp.where(r == c - half, 1.0, 0.0) - jnp.where(
+        r == c + half, 1.0, 0.0)
+    return p.astype(dtype)
+
+
+def _rope_tile(x, cos_ref, sin_ref):
+    """Apply rope to a [rows, Dh] tile (tables full-width)."""
+    c = _t2(cos_ref).astype(x.dtype)
+    s = _t2(sin_ref).astype(x.dtype)
+    # f32 accumulation (Mosaic requires 32-bit acc); the result is an
+    # exact signed permutation of x, so the cast back is lossless
+    rot = jax.lax.dot_general(
+        x, _rope_rot_mat(x.shape[-1], x.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    return x * c + rot * s
+
+
+def _unrope_tile(g, cos_ref, sin_ref):
+    """Transpose-of-rope on a [rows, Dh] fp32 gradient tile."""
+    c = _t2(cos_ref).astype(g.dtype)
+    s = _t2(sin_ref).astype(g.dtype)
+    rot = jax.lax.dot_general(
+        g * s, _rope_rot_mat(g.shape[-1], g.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ).astype(g.dtype)
+    return g * c - rot
+
+
 def _compiler_params(dims):
     try:
         return pltpu.CompilerParams(dimension_semantics=dims)
@@ -108,13 +163,14 @@ def _compiler_params(dims):
 
 
 def _col(ref):
-    """Load a row-stats block ([..., bq, 8]) as a (bq, 1) column.
+    """Load a row-stats block ([..., bq, STATS_W]) as a (bq, 1) column.
 
-    Row statistics (lse, delta) are stored 8 lanes wide: a trailing dim
-    of 1 forces a 1-of-128-lane physical tiling whose XLA-side layout
-    copies cost ~milliseconds per step, while 8 == the array dim is a
-    legal dense-ish Pallas block that matches XLA's natural descending
-    layout (no copies)."""
+    Row statistics (lse, delta) are stored STATS_W (=128) lanes wide: a
+    trailing dim of 1 forces a 1-of-128-lane physical tiling whose
+    XLA-side layout copies cost ~milliseconds per step, and any width
+    below 128 is physically lane-padded to 128 anyway — so full width
+    costs no extra HBM and keeps every stats DUS/slice/broadcast dense
+    and full-bandwidth."""
     x = ref[...]
     return x.reshape(x.shape[-2], x.shape[-1])[:, :1]
 
@@ -263,7 +319,7 @@ def _io_specs(layout, *, block_q, block_k, head_dim, group):
             lambda b, h, t, m: (b, m[1, t], h // group),
         )
     row_spec = pl.BlockSpec(
-        (1, 1, block_q, 8), lambda b, h, t, m: (b, h, m[0, t], 0)
+        (1, 1, block_q, STATS_W), lambda b, h, t, m: (b, h, m[0, t], 0)
     )
     return q_spec, kv_spec, row_spec
 
@@ -285,10 +341,15 @@ def _kv_out(layout, *, block_k, head_dim):
 
 
 def _fwd_kernel(
-    meta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-    m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
+    meta_ref, q_ref, k_ref, v_ref, *rest,
+    sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
+    rope=False,
 ):
+    if rope:
+        (cq_ref, sq_ref, ck_ref, sk_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr, qr_scr) = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     t = pl.program_id(2)
     i = meta_ref[0, t]
     j = meta_ref[1, t]
@@ -298,12 +359,22 @@ def _fwd_kernel(
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
+        if rope:
+            # rope the q tile ONCE per row (it stays resident across
+            # the row's kv visits); k ropes per visit (fresh tile)
+            qr_scr[:] = _rope_tile(_t2(q_ref), cq_ref, sq_ref) * (
+                jnp.asarray(sm_scale, q_ref.dtype))
 
     def _tile(masked):
         # sm_scale folded into the q tile: one [bq, d] multiply instead
         # of a [bq, bk] multiply on the score matrix
-        q = _t2(q_ref) * jnp.asarray(sm_scale, q_ref.dtype)
-        k = _zero_pad_rows(_t2(k_ref), j, block_k, kv_len)
+        if rope:
+            q = qr_scr[:]
+            k = _rope_tile(_t2(k_ref), ck_ref, sk_ref)
+        else:
+            q = _t2(q_ref) * jnp.asarray(sm_scale, q_ref.dtype)
+            k = _t2(k_ref)
+        k = _zero_pad_rows(k, j, block_k, kv_len)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -344,12 +415,25 @@ def _fwd_kernel(
         l_safe = jnp.where(l == 0.0, 1.0, l)
         _wr(o_ref, acc_scr[:] / l_safe)
         lse = m_scr[:, :1] + jnp.log(jnp.maximum(l_safe, 1e-30))
-        _wr(lse_ref, jnp.broadcast_to(lse, (lse.shape[0], 8)))
+        _wr(lse_ref, jnp.broadcast_to(lse, (lse.shape[0], STATS_W)))
+
+
+def _rope_specs(block_q, block_k, head_dim):
+    """Table blocks for [B, S, Dh] cos/sin: one slice per q tile, one
+    per kv tile (same arrays passed twice with different index maps)."""
+    rq = pl.BlockSpec(
+        (1, block_q, head_dim), lambda b, h, t, m: (b, m[0, t], 0))
+    rk = pl.BlockSpec(
+        (1, block_k, head_dim), lambda b, h, t, m: (b, m[1, t], 0))
+    return [rq, rq, rk, rk]
 
 
 def _fwd(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
-         block_k, interpret):
+         block_k, interpret, rope_cos=None, rope_sin=None):
     if layout == "bshdf":
+        if rope_cos is not None:
+            raise ValueError("fused rope is not supported on the fused-"
+                             "heads (bshdf) layout")
         return _fwd_fused(q, k, v, heads, kv_heads, sm_scale, causal,
                           block_q, block_k, interpret)
     batch, H, KVH, q_len, kv_len, head_dim = _fa_dims(
@@ -362,38 +446,47 @@ def _fwd(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
     meta = jnp.asarray(_tile_meta(
         nq, nk, block_q, block_k, q_len, kv_len, causal, False))
 
+    rope = rope_cos is not None
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
         p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
+        rope=rope,
     )
     q_spec, kv_spec, row_spec = _io_specs(
         layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
         group=group)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [q, k, v]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, head_dim), jnp.float32),
+    ]
+    if rope:
+        in_specs += _rope_specs(block_q, block_k, head_dim)
+        operands += [rope_cos, rope_sin, rope_cos, rope_sin]
+        scratch_shapes.append(pltpu.VMEM((block_q, head_dim), q.dtype))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(batch, H, meta.shape[1]),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=(q_spec, row_spec),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, head_dim), jnp.float32),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     o, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, H, q_len, 8), jnp.float32),
+            jax.ShapeDtypeStruct((batch, H, q_len, STATS_W), jnp.float32),
         ),
         compiler_params=_compiler_params(
             ("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(meta, q, k, v)
+    )(meta, *operands)
     return o, lse
 
 
@@ -474,7 +567,7 @@ def _fwdf_kernel(
         l = l_scr[:, :heads]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         lse = m_scr[:, :heads] + jnp.log(jnp.maximum(l_safe, 1e-30))
-        # lse block is [1, H, bq, 8]
+        # lse block is [1, H, bq, STATS_W]
         lse_ref[...] = jnp.broadcast_to(
             lse.T[:, :, None], lse_ref.shape[1:]
         ).reshape(lse_ref.shape).astype(lse_ref.dtype)
@@ -506,8 +599,8 @@ def _bwdf_dq_kernel(
         kb = _zero_pad_rows(_t2(k_ref), j, block_k, kv_len)
         vb = _zero_pad_rows(_t2(v_ref), j, block_k, kv_len)
         dob = _t2(do_ref)
-        lse_all = lse_ref[...].reshape(heads, block_q, 8)[..., 0].T  # [bq,H]
-        delta_all = delta_ref[...].reshape(heads, block_q, 8)[..., 0].T
+        lse_all = lse_ref[...].reshape(heads, block_q, STATS_W)[..., 0].T  # [bq,H]
+        delta_all = delta_ref[...].reshape(heads, block_q, STATS_W)[..., 0].T
         mask = None
         if masked:
             mask = _block_mask(
@@ -571,8 +664,8 @@ def _bwdf_dkv_kernel(
         kb = _t2(k_ref)
         vb = _t2(v_ref)
         dob = _zero_pad_rows(_t2(do_ref), i, block_q, q_len)
-        lse_all = lse_ref[...].reshape(heads, block_q, 8)[..., 0].T  # [bq,H]
-        delta_all = delta_ref[...].reshape(heads, block_q, 8)[..., 0].T
+        lse_all = lse_ref[...].reshape(heads, block_q, STATS_W)[..., 0].T  # [bq,H]
+        delta_all = delta_ref[...].reshape(heads, block_q, STATS_W)[..., 0].T
         delta_all = _zero_pad_rows(delta_all, i, block_q, q_len)
         mask = None
         if masked:
@@ -633,7 +726,7 @@ def _fwd_fused(q, k, v, heads, kv_heads, sm_scale, causal, block_q,
     kv_spec = pl.BlockSpec(
         (1, block_k, k.shape[2]), lambda b, t, m: (b, m[1, t], 0))
     lse_spec = pl.BlockSpec(
-        (1, heads, block_q, 8), lambda b, t, m: (b, 0, m[0, t], 0))
+        (1, heads, block_q, STATS_W), lambda b, t, m: (b, 0, m[0, t], 0))
     o, lse = pl.pallas_call(
         functools.partial(
             _fwdf_kernel, sm_scale=sm_scale, causal=causal,
@@ -654,7 +747,7 @@ def _fwd_fused(q, k, v, heads, kv_heads, sm_scale, causal, block_q,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, heads, q_len, 8), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, q_len, STATS_W), jnp.float32),
         ),
         compiler_params=_compiler_params(("parallel", "arbitrary")),
         interpret=interpret,
@@ -677,12 +770,12 @@ def _bwd_fused(heads, kv_heads, sm_scale, causal, block_q, block_k,
     delta = dof.reshape(batch, q_len, heads, head_dim).sum(-1)
     delta = jnp.broadcast_to(
         delta.transpose(0, 2, 1)[..., None],
-        (batch, heads, q_len, 8))
+        (batch, heads, q_len, STATS_W))
 
     q_spec = pl.BlockSpec((1, block_q, qd), lambda b, t, m: (b, m[0, t], 0))
     kv_spec = pl.BlockSpec((1, block_k, kvd), lambda b, t, m: (b, m[1, t], 0))
     row_spec = pl.BlockSpec(
-        (1, heads, block_q, 8), lambda b, t, m: (b, 0, m[0, t], 0))
+        (1, heads, block_q, STATS_W), lambda b, t, m: (b, 0, m[0, t], 0))
 
     meta_q = jnp.asarray(_tile_meta(
         nq, nk, block_q, block_k, q_len, kv_len, causal, False))
@@ -740,10 +833,14 @@ def _bwd_fused(heads, kv_heads, sm_scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel(
-    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    dq_scr,
-    *, sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
+    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
+    rope=False,
 ):
+    if rope:
+        cq_ref, sq_ref, ck_ref, sk_ref, dq_ref, dq_scr, qr_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     t = pl.program_id(2)
     i = meta_ref[0, t]
     j = meta_ref[1, t]
@@ -751,12 +848,20 @@ def _bwd_dq_kernel(
     @pl.when(meta_ref[2, t] == 1)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
+        if rope:
+            qr_scr[:] = _rope_tile(_t2(q_ref), cq_ref, sq_ref) * (
+                jnp.asarray(sm_scale, q_ref.dtype))
 
     def _tile(masked):
         # scaled-q trick: s uses q*sm_scale; ds stays unscaled and the
         # final dq is scaled once (dq = scale * ds @ k)
-        q = _t2(q_ref) * jnp.asarray(sm_scale, q_ref.dtype)
-        k = _zero_pad_rows(_t2(k_ref), j, block_k, kv_len)
+        if rope:
+            q = qr_scr[:]
+            k = _rope_tile(_t2(k_ref), ck_ref, sk_ref)
+        else:
+            q = _t2(q_ref) * jnp.asarray(sm_scale, q_ref.dtype)
+            k = _t2(k_ref)
+        k = _zero_pad_rows(k, j, block_k, kv_len)
         v = _zero_pad_rows(_t2(v_ref), j, block_k, kv_len)
         do = _t2(do_ref)
         lse = _col(lse_ref)
@@ -791,15 +896,22 @@ def _bwd_dq_kernel(
 
     @pl.when(meta_ref[3, t] == 1)
     def _final():
-        _wr(dq_ref, dq_scr[:] * sm_scale)
+        dq = dq_scr[:] * sm_scale
+        if rope:
+            dq = _unrope_tile(dq, cq_ref, sq_ref)
+        _wr(dq_ref, dq)
 
 
 def _bwd_dkv_kernel(
-    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref,
-    dk_scr, dv_scr,
-    *, sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
+    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
+    rope=False,
 ):
+    if rope:
+        (cq_ref, sq_ref, ck_ref, sk_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr, kr_scr) = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     t = pl.program_id(2)
     i = meta_ref[0, t]
     j = meta_ref[1, t]
@@ -808,13 +920,22 @@ def _bwd_dkv_kernel(
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
+        if rope:
+            # kv-major: the k tile stays resident across the column's
+            # q visits — rope it once; q ropes per visit
+            kr_scr[:] = _rope_tile(_t2(k_ref), ck_ref, sk_ref)
 
     def _tile(masked):
         # scaled-q trick: the scaled q tile serves both s = (q*scale)@k
         # and dk += ds^T (q*scale), so ds itself never needs scaling
-        q = _zero_pad_rows(_t2(q_ref), i, block_q, q_len)
+        q = _t2(q_ref)
+        if rope:
+            q = _rope_tile(q, cq_ref, sq_ref)
+            k = kr_scr[:]
+        else:
+            k = _t2(k_ref)
+        q = _zero_pad_rows(q, i, block_q, q_len)
         q = q * jnp.asarray(sm_scale, q.dtype)
-        k = _t2(k_ref)
         v = _t2(v_ref)
         do = _zero_pad_rows(_t2(do_ref), i, block_q, q_len)
         lse = _col(lse_ref)
@@ -855,13 +976,51 @@ def _bwd_dkv_kernel(
 
     @pl.when(meta_ref[3, t] == 1)
     def _final():
-        _wr(dk_ref, dk_scr[:])
+        dk = dk_scr[:]
+        if rope:
+            dk = _unrope_tile(dk, ck_ref, sk_ref)
+        _wr(dk_ref, dk)
         _wr(dv_ref, dv_scr[:])
 
 
+def _delta_kernel(do_ref, o_ref, out_ref):
+    dof = _t2(do_ref).astype(jnp.float32) * _t2(o_ref).astype(jnp.float32)
+    d = jnp.sum(dof, axis=-1, keepdims=True)
+    _wr(out_ref, jnp.broadcast_to(d, (d.shape[0], STATS_W)))
+
+
+def _delta_bhsd(do, o, block_q, interpret):
+    """delta = rowsum(do * o), emitted dense [B, H, S, STATS_W].
+
+    A dedicated mini-kernel: XLA lowers the same reduce+broadcast as a
+    [B,H,S] reduce followed by a sub-lane-masked broadcast write that
+    runs ~20x under bandwidth; the kernel writes the wide layout the
+    bwd kernels consume directly."""
+    batch, H, q_len, head_dim = do.shape
+    block_q = min(block_q, q_len)
+    spec = pl.BlockSpec(
+        (1, 1, block_q, head_dim), lambda b, h, i: (b, h, i, 0))
+    out_spec = pl.BlockSpec(
+        (1, 1, block_q, STATS_W), lambda b, h, i: (b, h, i, 0))
+    return pl.pallas_call(
+        _delta_kernel,
+        grid=(batch, H, pl.cdiv(q_len, block_q)),
+        in_specs=[spec, spec],
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, H, q_len, STATS_W), jnp.float32),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "parallel")),
+        interpret=interpret,
+    )(do, o)
+
+
 def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
-         interpret, res, do):
+         interpret, res, do, rope_cos=None, rope_sin=None):
     if layout == "bshdf":
+        if rope_cos is not None:
+            raise ValueError("fused rope is not supported on the fused-"
+                             "heads (bshdf) layout")
         return _bwd_fused(heads, kv_heads, sm_scale, causal, block_q,
                           block_k, interpret, res, do)
     q, k, v, o, lse = res
@@ -873,18 +1032,23 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
 
-    # delta = rowsum(do * o) per head, laid out [B, H, S, 1]
-    dof = do.astype(jnp.float32) * o.astype(jnp.float32)
+    # delta = rowsum(do * o) per head, dense [B, H, S, STATS_W]
     if layout == "bhsd":
-        delta = jnp.sum(dof, axis=-1, keepdims=True)
+        delta = _delta_bhsd(do, o, block_q, interpret)
     else:
+        dof = do.astype(jnp.float32) * o.astype(jnp.float32)
         delta = dof.reshape(batch, q_len, H, head_dim).sum(-1)
         delta = delta.transpose(0, 2, 1)[..., None]
-    delta = jnp.broadcast_to(delta, delta.shape[:-1] + (8,))
+        delta = jnp.broadcast_to(delta, delta.shape[:-1] + (STATS_W,))
 
     q_spec, kv_spec, row_spec = _io_specs(
         layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
         group=group)
+    rope = rope_cos is not None
+    rope_in_specs = (
+        _rope_specs(block_q, block_k, head_dim) if rope else [])
+    rope_operands = (
+        [rope_cos, rope_sin, rope_cos, rope_sin] if rope else [])
 
     meta_q = jnp.asarray(_tile_meta(
         nq, nk, block_q, block_k, q_len, kv_len, causal, False))
@@ -893,20 +1057,26 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
             p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
+            rope=rope,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(batch, H, meta_q.shape[1]),
-            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec,
+                      row_spec] + rope_in_specs,
             out_specs=q_spec,
-            scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+            scratch_shapes=(
+                [pltpu.VMEM((block_q, head_dim), jnp.float32)]
+                + ([pltpu.VMEM((block_q, head_dim), q.dtype)]
+                   if rope else [])
+            ),
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         compiler_params=_compiler_params(
             ("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(meta_q, q, k, v, do, lse, delta)
+    )(meta_q, q, k, v, do, lse, delta, *rope_operands)
 
     # dk/dv are produced per q-head (packed kv-major), then group-summed
     # for GQA.
@@ -922,16 +1092,22 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, q_len=q_len, kv_len=kv_len,
             p_zero=_needs_p_zero(causal, block_q, block_k, q_len, kv_len),
+            rope=rope,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(batch, H, meta_kv.shape[1]),
-            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec,
+                      row_spec] + rope_in_specs,
             out_specs=(kv_out_spec, kv_out_spec),
-            scratch_shapes=[
-                pltpu.VMEM((block_k, head_dim), jnp.float32),
-                pltpu.VMEM((block_k, head_dim), jnp.float32),
-            ],
+            scratch_shapes=(
+                [
+                    pltpu.VMEM((block_k, head_dim), jnp.float32),
+                    pltpu.VMEM((block_k, head_dim), jnp.float32),
+                ]
+                + ([pltpu.VMEM((block_k, head_dim), k.dtype)]
+                   if rope else [])
+            ),
         ),
         out_shape=(
             jax.ShapeDtypeStruct(kv_out_shape, q.dtype),
@@ -941,7 +1117,7 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
             ("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(meta_kv, q, k, v, do, lse, delta)
+    )(meta_kv, q, k, v, do, lse, delta, *rope_operands)
 
     if group > 1:
         if layout == "bhsd":
@@ -977,47 +1153,57 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
 # happen at the primal level.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(5, 15)))
-def _anchor(q, k, v, o, lse, layout, heads, kv_heads, sm_scale, causal,
-            block_q, block_k, bwd_block_q, bwd_block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(7, 17)))
+def _anchor(q, k, v, rope_cos, rope_sin, o, lse, layout, heads, kv_heads,
+            sm_scale, causal, block_q, block_k, bwd_block_q, bwd_block_k,
+            interpret):
     return o
 
 
-def _anchor_fwd(q, k, v, o, lse, layout, heads, kv_heads, sm_scale, causal,
-                block_q, block_k, bwd_block_q, bwd_block_k, interpret):
-    return o, (q, k, v, o, lse)
+def _anchor_fwd(q, k, v, rope_cos, rope_sin, o, lse, layout, heads,
+                kv_heads, sm_scale, causal, block_q, block_k, bwd_block_q,
+                bwd_block_k, interpret):
+    return o, (q, k, v, o, lse, rope_cos, rope_sin)
 
 
 def _anchor_bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
                 bwd_block_q, bwd_block_k, interpret, res, do):
+    q, k, v, o, lse, rope_cos, rope_sin = res
     dq, dk, dv = _bwd(
         layout, heads, kv_heads, sm_scale, causal, bwd_block_q, bwd_block_k,
-        interpret, res, do,
+        interpret, (q, k, v, o, lse), do,
+        rope_cos=rope_cos, rope_sin=rope_sin,
     )
-    _, _, _, o, lse = res
-    return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
+    zc = None if rope_cos is None else jnp.zeros_like(rope_cos)
+    zs = None if rope_sin is None else jnp.zeros_like(rope_sin)
+    return dq, dk, dv, zc, zs, jnp.zeros_like(o), jnp.zeros_like(lse)
 
 
 _anchor.defvjp(_anchor_fwd, _anchor_bwd)
 
 
 def _flash(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
-           block_k, bwd_block_q, bwd_block_k, interpret):
+           block_k, bwd_block_q, bwd_block_k, interpret,
+           rope_cos=None, rope_sin=None):
     from jax.ad_checkpoint import checkpoint_name
 
     # stop_gradient on the *inputs* keeps AD tracing out of the pallas
     # call entirely (it has no JVP rule); gradients flow only through
     # the anchor's q/k/v arguments.
+    if rope_cos is not None:
+        rope_cos = jax.lax.stop_gradient(rope_cos)
+        rope_sin = jax.lax.stop_gradient(rope_sin)
     o, lse = _fwd(
         jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
         jax.lax.stop_gradient(v), layout, heads, kv_heads, sm_scale, causal,
         block_q, block_k, interpret,
+        rope_cos=rope_cos, rope_sin=rope_sin,
     )
     o = checkpoint_name(o, "attn_out")
     lse = checkpoint_name(lse, "attn_out")
-    return _anchor(q, k, v, o, lse, layout, heads, kv_heads, sm_scale,
-                   causal, block_q, block_k, bwd_block_q, bwd_block_k,
-                   interpret)
+    return _anchor(q, k, v, rope_cos, rope_sin, o, lse, layout, heads,
+                   kv_heads, sm_scale, causal, block_q, block_k,
+                   bwd_block_q, bwd_block_k, interpret)
 
 
 def flash_attention(
@@ -1029,6 +1215,8 @@ def flash_attention(
     bwd_block_q: int | None = None,
     bwd_block_k: int | None = None,
     interpret: bool | None = None,
+    rope_cos=None,
+    rope_sin=None,
 ):
     """Multi-head attention, O(S) memory, MXU-tiled ([B,H,S,Dh] layout).
 
@@ -1038,6 +1226,13 @@ def flash_attention(
       bwd_block_q/k: backward-kernel tile sizes; default to the forward
         blocks. The dq/dkv kernels hold more live buffers per tile than
         the forward, so their VMEM-optimal blocks are often smaller.
+      rope_cos/rope_sin: optional [batch, q_len, head_dim] FULL-WIDTH
+        rotary tables (first-half values duplicated into the second
+        half). When given, rope is applied to q and k INSIDE the
+        kernels — q/k are passed raw, and dq/dk come back un-roped —
+        which removes the XLA-side rope read-modify-write passes
+        entirely (they run at sub-peak bandwidth as pad/concat
+        relayouts). Self-attention only (q_len == kv_len).
     Returns [batch, heads, q_len, head_dim] in q.dtype.
     """
     if sm_scale is None:
@@ -1045,13 +1240,22 @@ def flash_attention(
     if q.shape[1] % k.shape[1] != 0:
         raise ValueError(
             f"q heads {q.shape[1]} not divisible by kv {k.shape[1]}")
+    if rope_cos is not None:
+        if q.shape[2] != k.shape[2]:
+            raise ValueError(
+                "fused rope requires self-attention (q_len == kv_len)")
+        want = (q.shape[0], q.shape[2], q.shape[3])
+        if tuple(rope_cos.shape) != want or tuple(rope_sin.shape) != want:
+            raise ValueError(
+                f"rope tables must be [B, S, head_dim] {want}, got "
+                f"{tuple(rope_cos.shape)} / {tuple(rope_sin.shape)}")
     if interpret is None:
         interpret = _use_interpret()
     return _flash(q, k, v, "bhsd", int(q.shape[1]), int(k.shape[1]),
                   float(sm_scale), bool(causal),
                   int(block_q), int(block_k),
                   int(bwd_block_q or block_q), int(bwd_block_k or block_k),
-                  bool(interpret))
+                  bool(interpret), rope_cos=rope_cos, rope_sin=rope_sin)
 
 
 def flash_attention_bshd(

@@ -52,6 +52,45 @@ def test_flash_attention_grads_match_reference():
         assert float(jnp.max(jnp.abs(a - b))) / scale < 5e-2
 
 
+def test_flash_attention_fused_rope_matches_external():
+    """Kernel-fused rope (rope_cos/rope_sin args) must match applying
+    rope externally then calling plain attention — forward and all
+    gradients, including GQA."""
+    B, H, KVH, S, D = 2, 4, 2, 256, 128
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, KVH, S, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, KVH, S, D), jnp.float32)
+    half = D // 2
+    freqs = np.exp(-np.log(10000.0) * np.arange(half) / half)
+    ang = np.arange(S)[:, None] * freqs
+    cos1 = jnp.asarray(np.cos(ang), jnp.float32)
+    sin1 = jnp.asarray(np.sin(ang), jnp.float32)
+    cos_f = jnp.broadcast_to(jnp.concatenate([cos1, cos1], -1), (B, S, D))
+    sin_f = jnp.broadcast_to(jnp.concatenate([sin1, sin1], -1), (B, S, D))
+
+    def ext_rope(x):
+        c, s = cos1[None, None], sin1[None, None]
+        x1, x2 = x[..., :half], x[..., half:]
+        return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], -1)
+
+    def loss_fused(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=128,
+                            block_k=128, rope_cos=cos_f, rope_sin=sin_f)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(
+            mha_reference(ext_rope(q), ext_rope(k), v, causal=True)))
+
+    lf, gf = jax.value_and_grad(loss_fused, (0, 1, 2))(q, k, v)
+    lr, gr = jax.value_and_grad(loss_ref, (0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-4)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
+
+
 def test_flash_attention_gqa_heads():
     q, k, v = _qkv(heads=8, kv_heads=2)
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
