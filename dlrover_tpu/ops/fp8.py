@@ -36,27 +36,59 @@ E5M2_MAX = 57344.0
 
 
 class _Flag:
-    enabled = False
+    mode: str | None = None  # None | "fp8" | "int8"
+
+
+def quant_mode() -> str | None:
+    """The active low-precision qdot mode (trace-time)."""
+    return _Flag.mode
 
 
 def fp8_enabled() -> bool:
-    """Whether :func:`fp8_autocast` is active (trace-time)."""
-    return _Flag.enabled
+    """Whether ANY qdot quantization mode is active (trace-time).
+
+    Name kept for back-compat; gates the same call sites for the int8
+    mode (the einsum-form flash path must yield to qdot either way)."""
+    return _Flag.mode is not None
 
 
 @contextlib.contextmanager
-def fp8_autocast(enabled: bool = True):
-    """Trace-time switch: ``qdot`` quantizes while this is active."""
-    prev = _Flag.enabled
-    _Flag.enabled = enabled
+def quant_autocast(mode: str = "fp8"):
+    """Trace-time switch: ``qdot`` quantizes while this is active.
+
+    ``mode="int8"`` is the TPU-native path (v5e MXU has 2x int8
+    throughput and no fp8 units); ``mode="fp8"`` rounds through
+    e4m3/e5m2 and only pays off on hardware with fp8 units."""
+    if mode not in ("fp8", "int8"):
+        raise ValueError(f"unknown quant mode {mode!r}")
+    prev = _Flag.mode
+    _Flag.mode = mode
     try:
         yield
     finally:
-        _Flag.enabled = prev
+        _Flag.mode = prev
+
+
+@contextlib.contextmanager
+def _quant_disabled():
+    """Force-disable quantization inside an active autocast region."""
+    prev = _Flag.mode
+    _Flag.mode = None
+    try:
+        yield
+    finally:
+        _Flag.mode = prev
+
+
+def fp8_autocast(enabled: bool = True):
+    """Back-compat shim: ``enabled=False`` force-disables any active
+    mode (it must NOT be a no-op — callers use it to keep a numerically
+    sensitive matmul in bf16 inside an autocast region)."""
+    return quant_autocast("fp8") if enabled else _quant_disabled()
 
 
 def fp8_is_enabled() -> bool:
-    return _Flag.enabled
+    return _Flag.mode is not None
 
 
 def _amax_scale(x, fmax: float):
@@ -127,15 +159,19 @@ fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
 
 
 def qdot(a, b):
-    """``a @ b``, quantized to fp8 when :func:`fp8_autocast` is active.
+    """``a @ b``, quantized when :func:`quant_autocast` is active.
 
     The flag is read at trace time, so wrapping the loss trace in the
-    context (auto_accelerate does this for compute_dtype="fp8") is
-    enough — no per-call state threading. Only the linear-layer shape
-    (2-D weight on the right) takes the fp8 path; anything else falls
-    through to the plain dot."""
-    if _Flag.enabled and getattr(b, "ndim", 0) == 2 and \
+    context (auto_accelerate does this for compute_dtype="fp8"/"int8")
+    is enough — no per-call state threading. Only the linear-layer
+    shape (2-D weight on the right) takes the quantized path; anything
+    else falls through to the plain dot."""
+    if _Flag.mode is not None and getattr(b, "ndim", 0) == 2 and \
             getattr(a, "ndim", 0) >= 2:
+        if _Flag.mode == "int8":
+            from dlrover_tpu.ops.quantization import int8_dot
+
+            return int8_dot(a, b)
         return fp8_dot(a, b)
     return a @ b
 

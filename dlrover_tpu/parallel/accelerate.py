@@ -199,21 +199,43 @@ def auto_accelerate(
 
     # ---- train step --------------------------------------------------------
     compute_dtype = strategy.compute_dtype
-    # fp8 (reference Fp8Optimization analogue): params/activations stay
-    # bf16; the model's qdot matmuls quantize operands to e4m3/e5m2
-    # while the fp8_autocast trace flag is up
-    use_fp8 = compute_dtype == "fp8"
-    cast_dtype = "bfloat16" if use_fp8 else compute_dtype
+    # low-precision compute (reference Fp8Optimization analogue):
+    # params/activations stay bf16; the model's qdot matmuls quantize
+    # while the quant_autocast trace flag is up. "int8" is the
+    # TPU-native mode (2x MXU throughput on v5e); "fp8" is EMULATED on
+    # TPUs without fp8 units and measured ~20% slower than bf16 there.
+    quant = compute_dtype if compute_dtype in ("fp8", "int8") else None
+    if quant is not None:
+        import jax as _jax
+
+        kinds = {
+            getattr(d, "device_kind", "")
+            for d in (devices if devices is not None else _jax.devices())
+        }
+        if not any("v6" in k or "v7" in k for k in kinds):
+            # measured on v5e (DESIGN.md "Low-precision compute"): the
+            # emulated fp8 step is ~+20% and int8 ~+30% vs bf16 — XLA
+            # lowers int8 dots without MXU acceleration on this
+            # hardware. The engine's candidate generator never proposes
+            # these dtypes; an explicit request is honored but loud.
+            logger.warning(
+                "compute_dtype=%r on %s: no accelerated low-precision "
+                "matmul path on this hardware/stack — measured SLOWER "
+                "than bf16 (fp8 ~+20%%, int8 ~+30%% step time). "
+                "Keep bfloat16 unless you are on fp8/int8-MXU hardware.",
+                quant, sorted(kinds) or "unknown devices",
+            )
+    cast_dtype = "bfloat16" if quant else compute_dtype
     inner_loss = _remat_wrap(loss_fn, strategy.remat)
     accum = max(int(strategy.grad_accum), 1)
 
     def microbatch_grads(params, batch, rng):
         import contextlib
 
-        from dlrover_tpu.ops.fp8 import fp8_autocast
+        from dlrover_tpu.ops.fp8 import quant_autocast
 
         cparams = _compute_cast(params, cast_dtype)
-        ctx = fp8_autocast() if use_fp8 else contextlib.nullcontext()
+        ctx = quant_autocast(quant) if quant else contextlib.nullcontext()
         with ctx:
             if has_aux:
                 grad_fn = jax.value_and_grad(inner_loss, has_aux=True)

@@ -68,6 +68,15 @@ class TestCandidates:
         top = cands[0].mesh
         assert top.fsdp == 8 and top.tensor == 1 and top.pipe == 1
 
+    def test_candidates_never_propose_low_precision(self):
+        """auto_accelerate must never hand out a dtype that slows the
+        step (VERDICT r3 #3): fp8/int8 are measured slower than bf16 on
+        current TPUs, so the generator only emits bfloat16; explicit
+        user requests go through a warn-gate in accelerate.py."""
+        cands = candidate_strategies(8, small_analysis(), hbm_gb=16.0)
+        assert cands
+        assert all(s.compute_dtype == "bfloat16" for s in cands)
+
     def test_memory_filter_forces_sharding(self):
         # 7B params on tiny HBM: pure-DP (fsdp=1,data=8) must be infeasible
         a = small_analysis(param_count=7_000_000_000)

@@ -189,3 +189,56 @@ class TestEndToEndNumerics:
         l16 = self._run("bfloat16")
         assert l8[-1] < l8[0] * 0.9, f"fp8 loss did not drop: {l8}"
         assert abs(l8[-1] - l16[-1]) / l16[-1] < 0.05, (l8[-1], l16[-1])
+
+
+class TestInt8Dot:
+    def test_close_to_exact(self):
+        from dlrover_tpu.ops.quantization import int8_dot
+
+        rng = np.random.RandomState(0)
+        a = jnp.asarray(rng.randn(64, 128), jnp.float32)
+        b = jnp.asarray(rng.randn(128, 96), jnp.float32)
+        out = int8_dot(a, b)
+        ref = a @ b
+        err = float(jnp.max(jnp.abs(out - ref))) / float(
+            jnp.max(jnp.abs(ref)))
+        assert err < 0.03, err
+
+    def test_grads_are_full_precision(self):
+        from dlrover_tpu.ops.quantization import int8_dot
+
+        rng = np.random.RandomState(1)
+        a = jnp.asarray(rng.randn(32, 64), jnp.float32)
+        b = jnp.asarray(rng.randn(64, 16), jnp.float32)
+        g = jax.grad(lambda a, b: jnp.sum(int8_dot(a, b) ** 2), (0, 1))(
+            a, b)
+        gr = jax.grad(lambda a, b: jnp.sum((a @ b) ** 2), (0, 1))(a, b)
+        for x, y in zip(g, gr):
+            rel = float(jnp.max(jnp.abs(x - y))) / (
+                float(jnp.max(jnp.abs(y))) + 1e-6)
+            assert rel < 0.1, rel
+
+    def test_qdot_routes_int8_under_autocast(self):
+        from dlrover_tpu.ops.fp8 import qdot, quant_autocast
+
+        rng = np.random.RandomState(2)
+        a = jnp.asarray(rng.randn(16, 32), jnp.bfloat16)
+        b = jnp.asarray(rng.randn(32, 8), jnp.bfloat16)
+        plain = qdot(a, b)
+        with quant_autocast("int8"):
+            q = qdot(a, b)
+        # int8 rounding must change the result (proof the path engaged)
+        assert not np.allclose(np.asarray(plain, np.float32),
+                               np.asarray(q, np.float32), atol=0)
+        rel = float(jnp.max(jnp.abs(
+            q.astype(jnp.float32) - plain.astype(jnp.float32))))
+        assert rel < 1.0
+
+    def test_int8_tracks_bf16_training(self):
+        """Strategy.compute_dtype='int8' loss parity vs bf16 (VERDICT
+        r3 #3: the low-precision knob must not distort training)."""
+        helper = TestEndToEndNumerics()
+        l8 = helper._run("int8")
+        l16 = helper._run("bfloat16")
+        assert l8[-1] < l8[0] * 0.9, f"int8 loss did not drop: {l8}"
+        assert abs(l8[-1] - l16[-1]) / l16[-1] < 0.05, (l8[-1], l16[-1])
