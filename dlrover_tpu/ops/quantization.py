@@ -26,10 +26,16 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _symmetric_scale(absmax):
+    """absmax -> int8 scale with the zero-block guard (shared by the
+    optimizer-state kernel and the int8 matmul path)."""
+    return jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+
+
 def _quant_kernel(x_ref, u_ref, q_ref, scale_ref, *, stochastic):
     x = x_ref[:].astype(jnp.float32)
     absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    scale = _symmetric_scale(absmax)
     scaled = x / scale
     if stochastic:
         # floor(x + u), u ~ U[0,1): unbiased rounding for any real x.
@@ -162,15 +168,18 @@ def dequantize_int8(q, scales, orig_shape, dtype=jnp.float32,
 # int8 quantized matmul (AQT-style) — the low-precision COMPUTE path
 # ---------------------------------------------------------------------------
 #
-# The v5e MXU has native int8 throughput at 2x bf16 (394 vs 197 TOPS)
-# but NO fp8 units — emulated fp8 qdot measured +20% step time, so the
-# honest low-precision path on this hardware is int8: per-channel
-# symmetric scales, int8 x int8 -> int32 on the MXU, dequantize in the
-# epilogue. XLA lowers jax.lax.dot_general on int8 operands with
-# preferred_element_type=int32 natively. Gradients stay bf16 (weight
-# updates keep full-precision dynamics; only forward GEMMs quantize).
-# Reference capability: amp_optimization.py:197 Fp8Optimization (the
-# CUDA analogue picks fp8 because Hopper has fp8 units).
+# Per-channel symmetric scales, int8 x int8 -> int32 accumulation,
+# dequantize in the epilogue; gradients stay bf16 (full-precision
+# update dynamics — only forward GEMMs quantize). Reference
+# capability: amp_optimization.py:197 Fp8Optimization (the CUDA
+# analogue picks fp8 because Hopper has fp8 units).
+#
+# Measured reality (DESIGN.md "Low-precision compute"): the v5e MXU
+# datasheet lists 2x int8 throughput, but XLA:TPU currently lowers
+# int8 dot_general WITHOUT that path (raw int8 dot ~2x slower than
+# bf16 on-chip). auto_accelerate therefore never selects this dtype
+# and warn-gates explicit requests; the path exists for stacks and
+# hardware where the lowering pays.
 
 
 def _per_channel_q(x, axis):
@@ -179,7 +188,7 @@ def _per_channel_q(x, axis):
     Returns (q int8, scale f32 with ``axis`` kept as size 1)."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
                    keepdims=True)
-    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    scale = _symmetric_scale(amax)
     q = jnp.clip(
         jnp.round(x.astype(jnp.float32) / scale), -127, 127
     ).astype(jnp.int8)

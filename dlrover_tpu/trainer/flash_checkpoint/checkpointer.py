@@ -89,8 +89,15 @@ class FlashCheckpointer(Checkpointer):
             return self.engine.save_to_memory(step, state_dict)
         return self.engine.save_to_storage(step, state_dict, path)
 
-    def load_checkpoint(self, path: str = "", target=None):
-        return self.engine.load(path, target)
+    def load_checkpoint(self, path: str = "", target=None,
+                        zero_copy: bool = False):
+        """Restore (shm first, storage fallback).
+
+        ``zero_copy=True``: targetless shm restores return read-only
+        views instead of copies — use in the restart flow where the
+        state is immediately ``jax.device_put`` and no save can race
+        (engine.load docstring has the validity contract)."""
+        return self.engine.load(path, target, zero_copy=zero_copy)
 
     def latest_step(self) -> int:
         return self.engine.latest_step()

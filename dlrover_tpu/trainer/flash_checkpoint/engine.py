@@ -463,15 +463,31 @@ class CheckpointEngine:
 
     # ---------------------------------------------------------- load paths
 
-    def load(self, path: str = "", target=None):
+    def load(self, path: str = "", target=None, zero_copy: bool = False):
         """Restore, preferring shm (survives worker restarts within the
-        host) and falling back to storage (reference engine.load :315)."""
-        result = self._load_from_memory(target)
+        host) and falling back to storage (reference engine.load :315).
+
+        ``zero_copy=True`` (targetless shm loads) returns READ-ONLY
+        numpy arrays backed by the shm segment instead of copies — a
+        restore that is immediately consumed (``device_put``) completes
+        before any new save can rewrite the segment, so the defensive
+        copy is pure overhead there (a full state copy of a multi-GB
+        checkpoint costs seconds on a busy host). Contract: the arrays
+        are invalidated by the next ``save_to_memory``/
+        ``save_to_storage`` on this host; finish consuming before
+        saving again, and never pass them back into a save (the writer
+        would memcpy a region onto itself). Single-piece leaves are
+        true views; a leaf saved as multiple shards is assembled into a
+        fresh array (also marked read-only for a uniform contract).
+        The *targeted* restore path ignores ``zero_copy`` — it is
+        already shard-wise (peak host memory ~one shard) and
+        device-transfer-bound."""
+        result = self._load_from_memory(target, zero_copy=zero_copy)
         if result is not None:
             return result
         return self.load_from_storage(path, target)
 
-    def _load_from_memory(self, target=None):
+    def _load_from_memory(self, target=None, zero_copy: bool = False):
         result = self._shm_handler.read()
         if result is None:
             return None
@@ -525,20 +541,28 @@ class CheckpointEngine:
         for leaf, _, _ in (
             p for pieces in piece_map.values() for p in pieces
         ):
-            # .copy(): never hand out views into the live shm buffer —
-            # the next save would rewrite them under the caller.
-            arr = (
-                np.frombuffer(
-                    buf,
-                    dtype=np.dtype(leaf.dtype),
-                    count=_count(leaf.shape),
-                    offset=leaf.offset,
-                )
-                .reshape(leaf.shape)
-                .copy()
-            )
+            # default: .copy() — never hand out writable views into the
+            # live shm buffer (the next save would rewrite them under
+            # the caller). zero_copy: read-only views for the restart
+            # path (see load() docstring for the validity contract).
+            arr = np.frombuffer(
+                buf,
+                dtype=np.dtype(leaf.dtype),
+                count=_count(leaf.shape),
+                offset=leaf.offset,
+            ).reshape(leaf.shape)
+            if zero_copy:
+                arr = arr.view()
+                arr.flags.writeable = False
+            else:
+                arr = arr.copy()
             leaf_map.setdefault(names[leaf.path], []).append((leaf, arr))
         state = _assemble(leaf_map)
+        if zero_copy:
+            # multi-shard leaves come out of _assemble as fresh arrays;
+            # freeze them too so the read-only contract is uniform
+            for arr in state.values():
+                arr.flags.writeable = False
         logger.info("restored step %s from shared memory", meta.step)
         return _fill_target(state, target, meta.step)
 

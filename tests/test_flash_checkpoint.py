@@ -303,6 +303,28 @@ class TestReviewFixes:
             engine.load(target={"w": jnp.zeros((8, 8))})
         engine.close()
 
+    def test_zero_copy_load_views(self, tmp_path):
+        """zero_copy=True returns read-only views into shm (restart-path
+        restore without the multi-GB defensive copy); the default load
+        still returns independent writable copies."""
+        import numpy as np
+
+        engine = ReplicatedCheckpointEngine(str(tmp_path / "ckpt"))
+        state = {"w": jnp.arange(1024, dtype=jnp.float32)}
+        engine.save_to_memory(1, state)
+        views = engine.load(zero_copy=True)["state"]
+        assert not views["w"].flags.writeable
+        np.testing.assert_array_equal(
+            np.asarray(views["w"]), np.arange(1024, dtype=np.float32))
+        copies = engine.load()["state"]
+        assert copies["w"].flags.writeable
+        # a new save rewrites the segment under the views (documented
+        # contract), while the copy is unaffected
+        engine.save_to_memory(2, {"w": jnp.zeros(1024, jnp.float32)})
+        assert float(views["w"][5]) == 0.0
+        assert float(copies["w"][5]) == 5.0
+        engine.close()
+
     def test_dtype_mismatch_raises(self, tmp_path):
         """Same refusal as the shape path: a saved fp32 leaf must not
         silently restore into a bf16 target (ADVICE r3)."""
