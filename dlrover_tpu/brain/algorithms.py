@@ -64,9 +64,34 @@ def optimize_cold_create(store: MetricsStore, req: OptimizeRequest):
     return plan
 
 
+def _runtime_samples(records: list[dict]) -> list[dict]:
+    """Oldest-first JobRuntimeInfo-style samples embedded in records
+    (reporters attach them under ``runtime``; records come newest-first
+    from the store)."""
+    return [r["runtime"] for r in reversed(records) if r.get("runtime")]
+
+
+def _int_map(value) -> dict:
+    return {int(k): float(v) for k, v in (value or {}).items()}
+
+
 @register("worker_resource")
 def optimize_worker_resource(store: MetricsStore, req: OptimizeRequest):
     records = store.job_records(req.job_uuid, limit=100)
+    samples = _runtime_samples(records)
+    if samples:
+        # deep path: the reference's windowed decision (speed state,
+        # singularity filtering, idle/exhausted-PS replica moves);
+        # a None verdict falls THROUGH to the legacy heuristic
+        from dlrover_tpu.brain.runtime_opt import (
+            optimize_worker_resource_windowed,
+        )
+
+        plan = optimize_worker_resource_windowed(
+            samples, _int_map(req.config.get("ps_cpus")), req.config
+        )
+        if plan is not None:
+            return plan
     mems = [
         float(r["used_memory_mb"]) for r in records
         if r.get("used_memory_mb")
@@ -173,6 +198,18 @@ def optimize_hot_ps(store: MetricsStore, req: OptimizeRequest):
     carry per-node stats under ``nodes: [{node_id, cpu_percent,
     used_memory_mb}]``."""
     records = store.job_records(req.job_uuid, limit=20)
+    samples = _runtime_samples(records)
+    if samples:
+        from dlrover_tpu.brain.runtime_opt import optimize_hot_ps_windowed
+
+        plan = optimize_hot_ps_windowed(
+            samples,
+            _int_map(req.config.get("ps_cpus")),
+            _int_map(req.config.get("ps_memory")),
+            req.config,
+        )
+        if plan is not None:
+            return plan
     nodes = None
     for r in records:
         if r.get("nodes"):
@@ -224,6 +261,18 @@ def optimize_init_adjust(store: MetricsStore, req: OptimizeRequest):
     records = store.job_records(req.job_uuid, limit=50)
     if not records:
         return None
+    samples = _runtime_samples(records)
+    if samples:
+        from dlrover_tpu.brain.runtime_opt import (
+            optimize_ps_init_adjust_windowed,
+        )
+
+        plan = optimize_ps_init_adjust_windowed(
+            samples, req.config,
+            model_feature=req.config.get("model_feature"),
+        )
+        if plan is not None:
+            return plan
     step_threshold = int(req.config.get("step_count_threshold", 100))
     latest_step = next(
         (int(r["global_step"]) for r in records

@@ -136,6 +136,15 @@ class BrainReporter:
             metrics["worker_count"] = sample.worker_count
             if sample.max_used_memory_mb:
                 metrics["used_memory_mb"] = sample.max_used_memory_mb
+        runtime = {
+            k: getattr(sample, k, None)
+            for k in ("speed", "worker_cpu", "worker_memory",
+                      "ps_cpu", "ps_memory")
+        }
+        if runtime.get("worker_cpu") or runtime.get("ps_cpu"):
+            # per-node usage present: attach the JobRuntimeInfo-style
+            # sample the windowed algorithms consume
+            metrics["runtime"] = runtime
         return metrics
 
     def collect_metrics(self) -> dict:

@@ -25,6 +25,13 @@ class RuntimeSample:
     speed: float = 0.0
     worker_count: int = 0
     max_used_memory_mb: int = 0
+    # per-node usage maps (node_id -> used), mirroring the reference
+    # brain's JobRuntimeInfo — feed the windowed optimization
+    # algorithms (brain/runtime_opt.py)
+    worker_cpu: dict = field(default_factory=dict)
+    worker_memory: dict = field(default_factory=dict)
+    ps_cpu: dict = field(default_factory=dict)
+    ps_memory: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -121,6 +128,15 @@ class JobMetricCollector:
             ]
             if mems:
                 sample.max_used_memory_mb = int(max(mems))
+            for n in alive:
+                sample.worker_cpu[n.id] = n.used_resource.cpu
+                sample.worker_memory[n.id] = n.used_resource.memory
+            ps_nodes = self._job_manager.get_job_nodes(NodeType.PS)
+            for n in ps_nodes.values():
+                if n.is_released:
+                    continue
+                sample.ps_cpu[n.id] = n.used_resource.cpu
+                sample.ps_memory[n.id] = n.used_resource.memory
         for r in self.reporters:
             if hasattr(r, "report_runtime"):
                 r.report_runtime(sample)

@@ -321,3 +321,288 @@ class TestClusterMonitor:
         )
         assert plan["memory_mb"] >= 18000
         store.close()
+
+
+class TestRuntimeWindowedAlgorithms:
+    """Table-driven scenarios transcribed from the reference Go tests
+    (dlrover/go/brain/pkg/optimizer/implementation/optalgorithm/
+    optimize_job_worker_resource_test.go, optimize_job_hot_ps_resource_
+    test.go, optimize_job_ps_init_adjust_resource.go) — the *_test.go
+    cases are the spec for the windowed decision logic."""
+
+    def test_hot_ps_reference_scenario(self):
+        """Go TestOptimizeJobHotPSResource: 2 PS at 10 cores; node 1
+        averages 9 used (util 0.9 > 0.8) -> every PS scales by the
+        32-core-capped common ratio; node 1 lands exactly at 32."""
+        from dlrover_tpu.brain.runtime_opt import optimize_hot_ps_windowed
+
+        gib = 1024 ** 3
+        sample = {
+            "ps_cpu": {0: 6.0, 1: 9.0},
+            "ps_memory": {0: 4 * gib, 1: 4 * gib},
+            "worker_cpu": {},
+        }
+        plan = optimize_hot_ps_windowed(
+            [dict(sample) for _ in range(3)],
+            ps_cpus={0: 10.0, 1: 10.0},
+            ps_memory={0: 5 * gib, 1: 5 * gib},
+            config={
+                "hot_cpu_threshold": 0.8,
+                "hot_memory_threshold": 0.9,
+                "target_worker_count": 20,
+                "memory_adjust": 4e9,
+            },
+        )
+        assert plan is not None
+        adj = plan["node_adjustments"]
+        assert adj[1]["cpu_cores"] == 32
+        # the common ratio (32/9) scales node 0 past its 10-core cap too
+        assert adj[0]["cpu_cores"] == 22
+        # memory util 0.8 < 0.9 threshold: no memory adjustments
+        assert all("memory" not in p for p in adj.values())
+
+    def test_hot_ps_memory_needs_every_window_record(self):
+        """checkHotMemoryNodes: one calm sample clears the node."""
+        from dlrover_tpu.brain.runtime_opt import hot_memory_nodes
+
+        hot = {"ps_memory": {0: 9.5}}
+        calm = {"ps_memory": {0: 1.0}}
+        caps = {0: 10.0}
+        assert hot_memory_nodes([hot, hot, hot], caps, 0.9) == [0]
+        assert hot_memory_nodes([hot, calm, hot], caps, 0.9) == []
+
+    def _worker_samples(self, post_speed=10.0):
+        one_worker = {
+            "speed": 8.0,
+            "ps_cpu": {0: 4.0},
+            "worker_cpu": {0: 0.3},
+            "worker_memory": {0: 10.0},
+        }
+        five_workers = {
+            "speed": post_speed,
+            "ps_cpu": {0: 6.0},
+            "worker_cpu": {i: 0.35 for i in range(5)},
+            "worker_memory": {i: 20.0 for i in range(5)},
+        }
+        return [dict(one_worker) for _ in range(5)] + [
+            dict(five_workers) for _ in range(5)
+        ]
+
+    _worker_config = {
+        "max_replica": 10,
+        "step_count_threshold": 5,
+        "ps_cpu_exhausted": 0.95,
+        "ps_cpu_overload": 0.8,
+        "speed_less_percent": 0.1,
+        "replica_decrease_count": 1,
+        "max_init_count_per_step": 32,
+        "max_count_per_step": 4,
+        "memory_margin_percent": 0.2,
+        "cpu_margin_cores": 1.0,
+        "cpu_util_comp_count": 2,
+        "cpu_util_less_percent": 0.15,
+        "phase": "stable",
+    }
+
+    def test_worker_resource_add_replica_reference_scenario(self):
+        """Go TestOptimizeJobWorkerResource_AddReplica: idle PS (util
+        0.6 < 0.8) with increasing speed grows the fleet toward the
+        overload target: ceil(0.8/0.6 * 5) = 7; memory = peak 20 * 1.2;
+        cpu = ceil(window-avg 0.35 + 1 margin) = 2."""
+        from dlrover_tpu.brain.runtime_opt import (
+            optimize_worker_resource_windowed,
+        )
+
+        plan = optimize_worker_resource_windowed(
+            self._worker_samples(), {0: 10.0}, dict(self._worker_config)
+        )
+        assert plan == {
+            "worker_count": 7,
+            "worker_cpu_cores": 2,
+            "worker_memory": 24.0,
+        }
+
+    def test_worker_resource_decelerated_holds_fleet(self):
+        """Speed DROPPED >10% after the last replica change: do not
+        grow even though the PS is idle (speedDecelerated branch)."""
+        from dlrover_tpu.brain.runtime_opt import (
+            optimize_worker_resource_windowed,
+        )
+
+        plan = optimize_worker_resource_windowed(
+            self._worker_samples(post_speed=5.0), {0: 10.0},
+            dict(self._worker_config),
+        )
+        assert plan["worker_count"] == 5
+
+    def test_worker_resource_exhausted_ps_shrinks(self):
+        """Exhausted PS (window-avg util >= 0.95) sheds workers."""
+        from dlrover_tpu.brain.runtime_opt import (
+            optimize_worker_resource_windowed,
+        )
+
+        samples = self._worker_samples()
+        for s in samples[-3:]:
+            s["ps_cpu"] = {0: 9.8}
+        plan = optimize_worker_resource_windowed(
+            samples, {0: 10.0}, dict(self._worker_config)
+        )
+        assert plan["worker_count"] == 4
+
+    def test_singularity_filter_drops_uncorroborated_spike(self):
+        """preProcessRuntimeInfos: an overload spike no neighbour
+        corroborates is dropped; corroborated overloads stay."""
+        from dlrover_tpu.brain.runtime_opt import filter_singularities
+
+        calm = {"ps_cpu": {0: 3.0}}
+        spike = {"ps_cpu": {0: 9.9}}
+        caps = {0: 10.0}
+        kept = filter_singularities(
+            [dict(calm), dict(spike), dict(calm), dict(calm)],
+            caps, overload_util=0.8, comp_count=1, less_percent=0.15,
+        )
+        assert len(kept) == 3  # the lone spike is gone
+        kept2 = filter_singularities(
+            [dict(calm), dict(spike), dict(spike), dict(calm)],
+            caps, overload_util=0.8, comp_count=1, less_percent=0.15,
+        )
+        assert len(kept2) == 4  # neighbouring spikes corroborate
+
+    def test_singularity_filter_drops_changed_ps_set(self):
+        from dlrover_tpu.brain.runtime_opt import filter_singularities
+
+        old = {"ps_cpu": {0: 3.0, 1: 3.0}}
+        new = {"ps_cpu": {0: 3.0}}
+        kept = filter_singularities(
+            [dict(old), dict(old), dict(new)], {0: 10.0, 1: 10.0},
+            0.8, 1, 0.15,
+        )
+        assert kept == [new]
+
+    def test_speed_state_transitions(self):
+        from dlrover_tpu.brain.runtime_opt import (
+            SPEED_DECELERATED, SPEED_INCREASED, SPEED_STABLE,
+            training_speed_state,
+        )
+
+        def mk(speed, workers):
+            return {"speed": speed,
+                    "worker_cpu": {i: 0.1 for i in range(workers)}}
+
+        faster = [mk(8, 1)] * 3 + [mk(10, 5)] * 3
+        slower = [mk(8, 1)] * 3 + [mk(6, 5)] * 3
+        fresh = [mk(8, 1)] * 3 + [mk(10, 5)]  # too few post records
+        assert training_speed_state(faster, 3, 0.1) == SPEED_INCREASED
+        assert training_speed_state(slower, 3, 0.1) == SPEED_DECELERATED
+        assert training_speed_state(fresh, 3, 0.1) == SPEED_STABLE
+
+    def test_ps_init_adjust_reference_scenario(self):
+        """Skew-aware early PS sizing: recv-density CPU, skew-limited
+        free rate, replica from the target total CPU (hand-derived from
+        OptimizeJobPSInitAdjustResource's formulas)."""
+        from dlrover_tpu.brain.runtime_opt import (
+            optimize_ps_init_adjust_windowed,
+        )
+
+        sample = {
+            "speed": 5.0,
+            "ps_cpu": {0: 4.0, 1: 2.0},
+            "ps_memory": {0: 1e9, 1: 8e8},
+            "worker_cpu": {0: 0.3, 1: 0.3},
+        }
+        plan = optimize_ps_init_adjust_windowed(
+            [dict(sample) for _ in range(3)],
+            config={
+                "ps_margin_cpu": 4,
+                "target_worker_count": 32,
+                "step_count_threshold": 5,
+                "total_steps": 1e6,
+                "ps_memory_margin_percent": 0.2,
+            },
+            model_feature={"recv_op_count": 100},
+        )
+        # ps_cpu: max(ceil(0.08*50)+4, ceil(4)+4) = 8
+        # free rate: skew diff = 4-2 = 2 -> 8/2 ... capped by ps_cpu/diff
+        #   = 4; est workers = ceil(4*2) = 8 -> target = min(32, 8) = 8
+        # total cpu = (8/2)*6 = 24 -> replicas = ceil(24/8) = 3
+        assert plan == {
+            "ps_count": 3,
+            "ps_cpu_cores": 8.0,
+            "ps_memory": 1.2e9,
+        }
+
+    def test_algorithms_route_runtime_samples(self, brain):
+        """Records carrying ``runtime`` samples take the deep windowed
+        path end-to-end through the registered algorithm."""
+        client, service = brain
+        store = service.store
+        sample = {
+            "ps_cpu": {0: 6.0, 1: 9.0},
+            "ps_memory": {0: 4e9, 1: 4e9},
+            "worker_cpu": {},
+        }
+        for _ in range(3):
+            store.persist("uuid-rt", "job-rt", {"runtime": sample})
+        from dlrover_tpu.brain.algorithms import get_algorithm
+        from dlrover_tpu.brain.messages import OptimizeRequest
+
+        plan = get_algorithm("hot_ps")(store, OptimizeRequest(
+            job_uuid="uuid-rt", job_name="job-rt", opt_type="hot_ps",
+            config={
+                "ps_cpus": {0: 10.0, 1: 10.0},
+                "ps_memory": {0: 5e9, 1: 5e9},
+                "hot_cpu_threshold": 0.8,
+            },
+        ))
+        assert plan["node_adjustments"][1]["cpu_cores"] == 32
+
+    def test_init_adjust_no_speed_signal_returns_none(self):
+        """speed 0.0 is indistinguishable from 'monitor missing' — must
+        NOT plan ps_count=0 (that would kill the PS fleet)."""
+        from dlrover_tpu.brain.runtime_opt import (
+            optimize_ps_init_adjust_windowed,
+        )
+
+        sample = {"speed": 0.0, "ps_cpu": {0: 4.0},
+                  "ps_memory": {0: 1e9}, "worker_cpu": {0: 0.3}}
+        assert optimize_ps_init_adjust_windowed(
+            [dict(sample)] * 3, config={}) is None
+
+    def test_worker_resource_without_ps_signal_falls_back(self, brain):
+        """Worker-only SPMD samples (no ps_cpu) must not trip the
+        idle-PS growth rule; the legacy memory heuristic still fires."""
+        client, service = brain
+        store = service.store
+        sample = {"speed": 8.0,
+                  "worker_cpu": {i: 0.3 for i in range(8)},
+                  "worker_memory": {i: 10.0 for i in range(8)}}
+        for _ in range(4):
+            store.persist("uuid-spmd", "job-spmd",
+                          {"runtime": sample, "used_memory_mb": 100})
+        from dlrover_tpu.brain.algorithms import get_algorithm
+        from dlrover_tpu.brain.messages import OptimizeRequest
+
+        plan = get_algorithm("worker_resource")(store, OptimizeRequest(
+            job_uuid="uuid-spmd", job_name="job-spmd",
+            opt_type="worker_resource", config={},
+        ))
+        assert plan == {"memory_mb": 140}  # legacy peak*1.4, no growth
+
+    def test_hot_ps_cap_binds_fleet_wide(self):
+        """A colder node with a big absolute average must not be
+        planned past the 32-core ceiling via the common ratio."""
+        from dlrover_tpu.brain.runtime_opt import optimize_hot_ps_windowed
+
+        sample = {"ps_cpu": {0: 9.0, 1: 50.0},
+                  "ps_memory": {}, "worker_cpu": {0: 0.3}}
+        plan = optimize_hot_ps_windowed(
+            [dict(sample)] * 3,
+            ps_cpus={0: 10.0, 1: 100.0},
+            ps_memory={},
+            config={"hot_cpu_threshold": 0.8,
+                    "target_worker_count": 20},
+        )
+        assert all(
+            p["cpu_cores"] <= 32
+            for p in plan["node_adjustments"].values()
+        )
