@@ -207,7 +207,9 @@ def optimize_worker_resource_windowed(samples, ps_cpus: dict,
     cap on the increase; CPU = window max (startup) or window avg
     (stable) of per-worker usage + margin cores.
     """
-    if not ps_cpus or not any(_res(s, "ps_cpu") for s in samples):
+    if not ps_cpus or not any(
+        v > 0 for s in samples for v in _res(s, "ps_cpu").values()
+    ):
         # no PS load signal: the idle-PS growth rule would fire
         # unconditionally for worker-only SPMD jobs — defer to the
         # legacy usage-based sizing instead
@@ -279,8 +281,9 @@ def optimize_worker_resource_windowed(samples, ps_cpus: dict,
         cpu = math.ceil(cpu + float(config.get("cpu_margin_cores", 1.0)))
     return {
         "worker_count": min(replica, max_replica),
-        "worker_cpu_cores": cpu,
-        "worker_memory": memory,
+        "cpu_cores": cpu,
+        "memory_mb": memory,
+        "source": "windowed",
     }
 
 
@@ -321,13 +324,18 @@ def optimize_hot_ps_windowed(samples, ps_cpus: dict, ps_memory: dict,
             # must not be planned past the cap either
             opt = min(math.ceil(cpu * coeff), _MAX_PS_CPU)
             if opt > ps_cpus.get(n, float("inf")):
-                plans[n] = {"cpu_cores": opt}
+                plans[str(n)] = {"cpu_cores": opt}
     for n in hot_mem:
         total = ps_memory.get(n)
         if total is None:
             continue
-        plans.setdefault(n, {})["memory"] = total + mem_adjust
-    return {"node_adjustments": plans} if plans else None
+        plans.setdefault(str(n), {})["memory_mb"] = total + mem_adjust
+    if not plans:
+        return None
+    # str node keys + *_mb field names keep the schema compatible with
+    # the legacy hot_ps plan consumers; "source" lets callers detect
+    # the windowed decision
+    return {"node_adjustments": plans, "source": "windowed"}
 
 
 def optimize_ps_init_adjust_windowed(samples, config: dict,
@@ -408,5 +416,6 @@ def optimize_ps_init_adjust_windowed(samples, config: dict,
     return {
         "ps_count": int(ps_replica),
         "ps_cpu_cores": float(ps_cpu),
-        "ps_memory": max_used_memory * (1 + mem_margin),
+        "ps_memory_mb": max_used_memory * (1 + mem_margin),
+        "source": "windowed",
     }

@@ -54,8 +54,10 @@ class LlamaConfig:
     remat: bool = True               # checkpoint each scanned layer
     # checkpoint policy when remat=True: "dots_attn" saves weight
     # matmuls AND the flash-attention output (the Pallas kernel is the
-    # costliest op to recompute); "dots_no_batch" saves weight matmuls
-    # only; "dots" additionally saves batched dots
+    # costliest op to recompute); "dots_attn_offload" sends the dot
+    # saves to pinned host memory instead of HBM (pair with
+    # auto_accelerate(infer_out_shardings=True)); "dots_no_batch"
+    # saves weight matmuls only; "dots" additionally saves batched dots
     remat_policy: str = "dots_attn"
     # measured on v5e (nano-350m, seq 2048): 1024x1024 beats 512x512 by
     # ~15% tokens/s; 2048-wide K blocks fail to fit VMEM. A bwd-block
@@ -478,6 +480,29 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
     return shard_logical(x, ("batch", "seq", "embed")), aux
 
 
+def _offload_dots_save_attn_policy():
+    """dots -> pinned-host offload, "attn_out" names -> saved in HBM,
+    everything else -> recompute. Hand-composed because
+    save_from_both_policies only merges boolean policies and the
+    offload variants return Offloadable markers."""
+    offload = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+        "device", "pinned_host"
+    )
+    names = jax.checkpoint_policies.save_only_these_names("attn_out")
+
+    def policy(prim, *args, **kwargs):
+        # the offload policy answers Offloadable (has src/dst) for
+        # unbatched dots and a Recompute SENTINEL (truthy!) otherwise —
+        # only a real offload/save verdict may short-circuit the
+        # attn_out name check
+        verdict = offload(prim, *args, **kwargs)
+        if verdict is True or hasattr(verdict, "dst"):
+            return verdict
+        return names(prim, *args, **kwargs)
+
+    return policy
+
+
 def _stage_fn(config: LlamaConfig):
     """Per-stage layer-scan closure shared by the pipeline schedules."""
     from dlrover_tpu.parallel.pipeline import stage_layer_scan
@@ -487,6 +512,11 @@ def _stage_fn(config: LlamaConfig):
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names("attn_out"),
         ),
+        # selective offload: the dot saves go to pinned host memory,
+        # attn_out (the costliest recompute) stays in HBM.
+        # save_from_both_policies cannot combine offload policies (they
+        # return Offloadable markers, not booleans) — compose by hand.
+        "dots_attn_offload": _offload_dots_save_attn_policy(),
         "dots_no_batch":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         "dots": jax.checkpoint_policies.dots_saveable,

@@ -96,6 +96,15 @@ def _remat_wrap(loss_fn, policy_name: str):
         return loss_fn
     if policy_name == "minimal":
         policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif policy_name == "offload":
+        # selective activation offloading (reference
+        # selective_offloading_checkpoint.py:1): the tensors "minimal"
+        # would keep in HBM round-trip to pinned host memory instead —
+        # HBM high-water drops toward the "full" level while the
+        # backward re-reads saves over PCIe/DMA instead of recomputing
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
     else:  # "full"
         policy = jax.checkpoint_policies.nothing_saveable
     return jax.checkpoint(loss_fn, policy=policy)
@@ -111,6 +120,7 @@ def auto_accelerate(
     devices=None,
     has_aux: bool = False,
     seed: int = 0,
+    infer_out_shardings: bool = False,
 ) -> AccelerateResult:
     """Build mesh + sharded state + jitted train step for ``strategy``.
 
@@ -118,6 +128,12 @@ def auto_accelerate(
     microbatch accumulation with a ``lax.scan`` (keeping one compiled
     program regardless of accumulation count) and applies the optimizer
     update under the same shardings.
+
+    ``infer_out_shardings``: set True when the MODEL applies a host-
+    offload checkpoint policy internally (e.g. LlamaConfig
+    remat_policy="dots_attn_offload") — explicit out_shardings plus
+    offload placement annotations trip an XLA RET_CHECK in this build;
+    strategy.remat="offload" switches automatically.
     """
     import jax
     import jax.numpy as jnp
@@ -311,10 +327,20 @@ def auto_accelerate(
 
     donate = (0,) if strategy.donate else ()
     with mesh:
+        # remat="offload": explicit out_shardings combined with the
+        # host-offload placement annotations trip an XLA RET_CHECK
+        # ("Side-effect HLO must have sharding", spmd_partitioner.cc)
+        # in this jax/XLA build — let the output shardings be inferred
+        # from the (identically-pinned) input shardings instead
+        out_sh = (
+            None
+            if strategy.remat == "offload" or infer_out_shardings
+            else (state_shardings, None)
+        )
         jitted_step = jax.jit(
             train_step,
             in_shardings=(state_shardings, None, None),
-            out_shardings=(state_shardings, None),
+            out_shardings=out_sh,
             donate_argnums=donate,
         )
 

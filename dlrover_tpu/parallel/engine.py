@@ -130,9 +130,11 @@ def estimate_hbm_per_device(
     model_shard = max(m.fsdp * m.tensor * m.expert * m.pipe, 1)
     # fp32 master params + grads + 2x Adam moments
     model_state = analysis.param_count * 4.0 * 4.0 / model_shard
-    act_discount = {"none": 1.0, "minimal": 0.35, "full": 0.12}.get(
-        strategy.remat, 0.35
-    )
+    # "offload" keeps only the full-level boundary tensors in HBM (the
+    # minimal-level dot saves live in pinned host memory)
+    act_discount = {
+        "none": 1.0, "minimal": 0.35, "offload": 0.15, "full": 0.12,
+    }.get(strategy.remat, 0.35)
     act_shard = max(m.seq, 1)
     # stored per layer (bf16): residual + 2 norm inputs (3x hidden),
     # q/k/v/o (4x hidden), gate/up hidden (~2 x 3x hidden) + lse rows
@@ -207,7 +209,7 @@ def candidate_strategies(
         )
         # cheapest-compute first: the first memory-feasible remat level
         # wins ('none' is fastest when it fits)
-        for remat in ("none", "minimal", "full"):
+        for remat in ("none", "minimal", "offload", "full"):
             s = Strategy(mesh=mesh, remat=remat)
             est = estimate_hbm_per_device(
                 analysis, s, batch_per_device, seq_len, hidden
@@ -221,7 +223,8 @@ def candidate_strategies(
                 + 0.05 * tensor / devices_per_host
                 + 0.25 * (pipe > 1)
                 + 0.02 * pipe
-                + {"none": 0.0, "minimal": 0.05, "full": 0.15}[remat]
+                + {"none": 0.0, "minimal": 0.05, "offload": 0.10,
+                   "full": 0.15}[remat]
                 + 0.10 * (data > 1 and fsdp == 1)  # pure DP replicates
             )
             out.append((score, s))
@@ -398,9 +401,9 @@ def _strategy_features(s: Strategy):
     import math
 
     m = s.mesh
-    remat_ord = {"none": 0.0, "minimal": 1.0, "full": 2.0}.get(
-        s.remat, 1.0
-    )
+    remat_ord = {
+        "none": 0.0, "minimal": 1.0, "offload": 1.5, "full": 2.0,
+    }.get(s.remat, 1.0)
     return [
         math.log2(max(m.data, 1)),
         math.log2(max(m.fsdp, 1)),

@@ -31,7 +31,9 @@ class Strategy:
     rules: LogicalRules = DEFAULT_RULES
     # compute precision for matmuls/activations; params stay fp32 master.
     compute_dtype: str = "bfloat16"
-    # remat policy name: none | minimal | full (jax.checkpoint policies)
+    # remat policy name: none | minimal | offload | full
+    # (jax.checkpoint policies; "offload" round-trips the minimal-level
+    # saves through pinned host memory — HBM relief without recompute)
     remat: str = "minimal"
     # number of microbatches for gradient accumulation (elastic trainer
     # raises this as world size shrinks to keep global batch fixed).

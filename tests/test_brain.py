@@ -355,9 +355,9 @@ class TestRuntimeWindowedAlgorithms:
         )
         assert plan is not None
         adj = plan["node_adjustments"]
-        assert adj[1]["cpu_cores"] == 32
+        assert adj["1"]["cpu_cores"] == 32
         # the common ratio (32/9) scales node 0 past its 10-core cap too
-        assert adj[0]["cpu_cores"] == 22
+        assert adj["0"]["cpu_cores"] == 22
         # memory util 0.8 < 0.9 threshold: no memory adjustments
         assert all("memory" not in p for p in adj.values())
 
@@ -418,8 +418,9 @@ class TestRuntimeWindowedAlgorithms:
         )
         assert plan == {
             "worker_count": 7,
-            "worker_cpu_cores": 2,
-            "worker_memory": 24.0,
+            "cpu_cores": 2,
+            "memory_mb": 24.0,
+            "source": "windowed",
         }
 
     def test_worker_resource_decelerated_holds_fleet(self):
@@ -528,7 +529,8 @@ class TestRuntimeWindowedAlgorithms:
         assert plan == {
             "ps_count": 3,
             "ps_cpu_cores": 8.0,
-            "ps_memory": 1.2e9,
+            "ps_memory_mb": 1.2e9,
+            "source": "windowed",
         }
 
     def test_algorithms_route_runtime_samples(self, brain):
@@ -554,7 +556,7 @@ class TestRuntimeWindowedAlgorithms:
                 "hot_cpu_threshold": 0.8,
             },
         ))
-        assert plan["node_adjustments"][1]["cpu_cores"] == 32
+        assert plan["node_adjustments"]["1"]["cpu_cores"] == 32
 
     def test_init_adjust_no_speed_signal_returns_none(self):
         """speed 0.0 is indistinguishable from 'monitor missing' — must
