@@ -340,14 +340,35 @@ def _kv_out(layout, *, block_k, head_dim):
 # ---------------------------------------------------------------------------
 
 
+def _dyn_mask(shape, i, j, off_ref, *, block_q, block_k, q_len, kv_len):
+    """Global-position causal mask from DYNAMIC offsets (ring attention:
+    row r of this block is global position off[0] + i*bq + r; visibility
+    is q_global >= k_global). Fully-masked tiles (a later chunk
+    visiting) fall out as all-False -> zero contribution. Pad rows/cols
+    beyond the true shard lengths are conjoined out exactly like
+    _block_mask's bounds terms (their zero-padded scores would
+    otherwise inflate l / NaN the backward)."""
+    local_r = i * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    local_c = j * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = (off_ref[0] + local_r) >= (off_ref[1] + local_c)
+    if q_len % block_q != 0:
+        mask = mask & (local_r < q_len)
+    if kv_len % block_k != 0:
+        mask = mask & (local_c < kv_len)
+    return mask
+
+
 def _fwd_kernel(
     meta_ref, q_ref, k_ref, v_ref, *rest,
     sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
-    rope=False,
+    rope=False, dyn_mask=False,
 ):
+    rest = list(rest)
     if rope:
         (cq_ref, sq_ref, ck_ref, sk_ref,
          o_ref, lse_ref, m_scr, l_scr, acc_scr, qr_scr) = rest
+    elif dyn_mask:
+        (off_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr) = rest
     else:
         o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     t = pl.program_id(2)
@@ -380,7 +401,11 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         )
         mask = None
-        if masked:
+        if dyn_mask:
+            mask = _dyn_mask(s.shape, i, j, off_ref,
+                             block_q=block_q, block_k=block_k,
+                             q_len=q_len, kv_len=kv_len)
+        elif masked:
             mask = _block_mask(
                 s.shape, i, j, block_q=block_q, block_k=block_k,
                 causal=causal, q_len=q_len, kv_len=kv_len,
@@ -406,8 +431,11 @@ def _fwd_kernel(
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
-                   block_k=block_k, q_len=q_len, kv_len=kv_len)
+    if dyn_mask:
+        _tile(True)  # every tile needs the dynamic global-position mask
+    else:
+        _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
+                       block_k=block_k, q_len=q_len, kv_len=kv_len)
 
     @pl.when(meta_ref[3, t] == 1)
     def _final():
@@ -835,10 +863,12 @@ def _bwd_fused(heads, kv_heads, sm_scale, causal, block_q, block_k,
 def _bwd_dq_kernel(
     meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
-    rope=False,
+    rope=False, dyn_mask=False,
 ):
     if rope:
         cq_ref, sq_ref, ck_ref, sk_ref, dq_ref, dq_scr, qr_scr = rest
+    elif dyn_mask:
+        off_ref, dq_ref, dq_scr = rest
     else:
         dq_ref, dq_scr = rest
     t = pl.program_id(2)
@@ -871,7 +901,11 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
         mask = None
-        if masked:
+        if dyn_mask:
+            mask = _dyn_mask(s.shape, i, j, off_ref,
+                             block_q=block_q, block_k=block_k,
+                             q_len=q_len, kv_len=kv_len)
+        elif masked:
             mask = _block_mask(
                 s.shape, i, j, block_q=block_q, block_k=block_k,
                 causal=causal, q_len=q_len, kv_len=kv_len,
@@ -891,8 +925,11 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
-                   block_k=block_k, q_len=q_len, kv_len=kv_len)
+    if dyn_mask:
+        _tile(True)  # every tile needs the dynamic global-position mask
+    else:
+        _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
+                       block_k=block_k, q_len=q_len, kv_len=kv_len)
 
     @pl.when(meta_ref[3, t] == 1)
     def _final():
@@ -905,11 +942,13 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
-    rope=False,
+    rope=False, dyn_mask=False,
 ):
     if rope:
         (cq_ref, sq_ref, ck_ref, sk_ref,
          dk_ref, dv_ref, dk_scr, dv_scr, kr_scr) = rest
+    elif dyn_mask:
+        off_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
     else:
         dk_ref, dv_ref, dk_scr, dv_scr = rest
     t = pl.program_id(2)
@@ -945,7 +984,11 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
         mask = None
-        if masked:
+        if dyn_mask:
+            mask = _dyn_mask(s.shape, i, j, off_ref,
+                             block_q=block_q, block_k=block_k,
+                             q_len=q_len, kv_len=kv_len)
+        elif masked:
             mask = _block_mask(
                 s.shape, i, j, block_q=block_q, block_k=block_k,
                 causal=causal, q_len=q_len, kv_len=kv_len,
@@ -971,8 +1014,11 @@ def _bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
-                   block_k=block_k, q_len=q_len, kv_len=kv_len)
+    if dyn_mask:
+        _tile(True)  # every tile needs the dynamic global-position mask
+    else:
+        _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
+                       block_k=block_k, q_len=q_len, kv_len=kv_len)
 
     @pl.when(meta_ref[3, t] == 1)
     def _final():
@@ -1137,6 +1183,159 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
     else:
         dk, dv = dk_full, dv_full
     return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# ring-attention block calls (dynamic global-position masking)
+# ---------------------------------------------------------------------------
+#
+# parallel/sequence.py's ring schedule visits one (q_shard, kv_shard)
+# block per tick with kv rotating over ppermute. These raw kernel
+# entries run ONE such block with causality decided by dynamic global
+# offsets (q_start, k_start) carried in SMEM — the visiting chunk's
+# relation (before/on/after the diagonal) is data-dependent under SPMD,
+# so it cannot be a static causal flag. No custom_vjp here: the ring
+# schedule owns its VJP (it must merge lse across visits and rotate
+# cotangents), calling these primitives in both passes.
+
+
+class _RingSetup:
+    """Shared geometry for one ring block call: clamped blocks, the
+    all-tiles meta (no static causal skipping — visibility is dynamic),
+    SMEM offsets and the bhsd block specs."""
+
+    def __init__(self, q, k, q_start, k_start, block_q, block_k,
+                 kv_major):
+        self.batch, self.H, self.q_len, self.head_dim = q.shape
+        self.KVH, self.kv_len = k.shape[1], k.shape[2]
+        self.group = self.H // self.KVH
+        self.block_q = min(block_q, self.q_len)
+        self.block_k = min(block_k, self.kv_len)
+        nq = pl.cdiv(self.q_len, self.block_q)
+        nk = pl.cdiv(self.kv_len, self.block_k)
+        self.meta = jnp.asarray(_tile_meta(
+            nq, nk, self.block_q, self.block_k, self.q_len, self.kv_len,
+            False, kv_major))
+        self.off = jnp.stack([jnp.asarray(q_start, jnp.int32),
+                              jnp.asarray(k_start, jnp.int32)])
+        self.q_spec, self.kv_spec, self.row_spec = _io_specs(
+            "bhsd", block_q=self.block_q, block_k=self.block_k,
+            head_dim=self.head_dim, group=self.group)
+        self.off_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    def kernel_args(self):
+        return dict(
+            block_q=self.block_q, block_k=self.block_k,
+            q_len=self.q_len, kv_len=self.kv_len, p_zero=True,
+            dyn_mask=True, causal=False,
+        )
+
+
+def ring_fwd_block(q, k, v, q_start, k_start, sm_scale,
+                   block_q=512, block_k=512, interpret=None):
+    """One ring block: (o_normalized, lse) with global causal masking.
+
+    q: [B, H, Sq, D]; k/v: [B, KVH, Sk, D]; q_start/k_start: traced s32
+    global offsets of this q/kv shard. Returns (o [q.shape],
+    lse [B, H, Sq, STATS_W] f32).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    g = _RingSetup(q, k, q_start, k_start, block_q, block_k, False)
+    return pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, **g.kernel_args()),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(g.batch, g.H, g.meta.shape[1]),
+            in_specs=[g.q_spec, g.kv_spec, g.kv_spec, g.off_spec],
+            out_specs=(g.q_spec, g.row_spec),
+            scratch_shapes=[
+                pltpu.VMEM((g.block_q, 128), jnp.float32),
+                pltpu.VMEM((g.block_q, 128), jnp.float32),
+                pltpu.VMEM((g.block_q, g.head_dim), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((g.batch, g.H, g.q_len, STATS_W),
+                                 jnp.float32),
+        ),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(g.meta, q, k, v, g.off)
+
+
+def ring_dq_block(q, k, v, do, lse, delta, q_start, k_start, sm_scale,
+                  block_q=512, block_k=512, interpret=None):
+    """dq contribution of one visiting kv block (global lse/delta).
+
+    Emitted in f32: the ring accumulates n per-block contributions, and
+    rounding each to the model dtype first would quantize the gradient
+    once per tick (the monolithic kernel rounds exactly once)."""
+    if interpret is None:
+        interpret = _use_interpret()
+    g = _RingSetup(q, k, q_start, k_start, block_q, block_k, False)
+    return pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, **g.kernel_args()),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(g.batch, g.H, g.meta.shape[1]),
+            in_specs=[g.q_spec, g.kv_spec, g.kv_spec, g.q_spec,
+                      g.row_spec, g.row_spec, g.off_spec],
+            out_specs=g.q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((g.block_q, g.head_dim), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(g.meta, q, k, v, do, lse, delta, g.off)
+
+
+def ring_dkv_block(q, k, v, do, lse, delta, q_start, k_start, sm_scale,
+                   block_q=512, block_k=512, interpret=None):
+    """(dk, dv) contribution of one visiting q block, group-summed for
+    GQA (kv shapes), emitted in f32 (see ring_dq_block)."""
+    if interpret is None:
+        interpret = _use_interpret()
+    g = _RingSetup(q, k, q_start, k_start, block_q, block_k, True)
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, **g.kernel_args()),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(g.batch, g.H, g.meta.shape[1]),
+            in_specs=[g.q_spec, g.kv_spec, g.kv_spec, g.q_spec,
+                      g.row_spec, g.row_spec, g.off_spec],
+            out_specs=(
+                _kv_out("bhsd", block_k=g.block_k,
+                        head_dim=g.head_dim),
+            ) * 2,
+            scratch_shapes=[
+                pltpu.VMEM((g.block_k, g.head_dim), jnp.float32),
+                pltpu.VMEM((g.block_k, g.head_dim), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(
+                (g.batch, g.H, g.kv_len, g.head_dim), jnp.float32),
+            jax.ShapeDtypeStruct(
+                (g.batch, g.H, g.kv_len, g.head_dim), jnp.float32),
+        ),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(g.meta, q, k, v, do, lse, delta, g.off)
+    if g.group > 1:
+        dk_full = dk_full.reshape(
+            g.batch, g.KVH, g.group, g.kv_len, g.head_dim).sum(axis=2)
+        dv_full = dv_full.reshape(
+            g.batch, g.KVH, g.group, g.kv_len, g.head_dim).sum(axis=2)
+    return dk_full, dv_full
 
 
 # ---------------------------------------------------------------------------

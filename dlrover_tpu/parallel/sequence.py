@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dlrover_tpu.ops.attention import NEG_INF, flash_attention
+from dlrover_tpu.ops.attention import NEG_INF, _use_interpret, flash_attention
 from dlrover_tpu.parallel.mesh import get_mesh
 
 __all__ = [
@@ -74,12 +74,157 @@ def _block_attn(q, k, v, q_chunk, kv_chunk, sm_scale, causal):
             l.reshape(b, h, sq, 1))
 
 
+# ---------------------------------------------------------------------------
+# ring attention with the Pallas flash kernel as the inner block
+# ---------------------------------------------------------------------------
+#
+# The einsum block above is numerically exact but leaves the packed-grid
+# flash kernel's efficiency on the table on a real seq mesh; this path
+# (the default for causal rings) runs each visiting block through
+# ops/attention.py ring_fwd_block (dynamic global-position masking) and
+# merges normalized (o, lse) pairs online. The backward is a second ring
+# pass through the flash dq/dkv kernels against the GLOBAL lse/delta —
+# p = exp(s - LSE_global) reproduces the softmax weights blockwise, so
+# no per-block statistics need saving. The forward rotates kv as ONE
+# stacked ppermute per tick; the backward needs two (kv in the model
+# dtype, cotangents in f32 — not stackable) serialized with an
+# optimization_barrier: XLA:CPU reorders independent collectives per
+# device and deadlocks the test mesh otherwise. Blocks entirely in the
+# future of this device's q shard are skipped on TPU via the pipeline
+# _gated pattern (computed-and-discarded on the CPU mesh, where
+# branch-divergent thunk streams deadlock).
+
+
+def _merge_block(o_acc, lse_acc, o_blk, lse_blk):
+    """Merge a normalized block (o, lse) into the running pair."""
+    m = jnp.maximum(lse_acc, lse_blk)
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    w_acc = jnp.where(lse_acc <= NEG_INF / 2, 0.0,
+                      jnp.exp(lse_acc - m_safe))
+    w_blk = jnp.where(lse_blk <= NEG_INF / 2, 0.0,
+                      jnp.exp(lse_blk - m_safe))
+    w_sum = w_acc + w_blk
+    w_safe = jnp.where(w_sum == 0.0, 1.0, w_sum)
+    o = (o_acc * w_acc + o_blk.astype(jnp.float32) * w_blk) / w_safe
+    lse = jnp.where(
+        w_sum == 0.0, NEG_INF, m_safe + jnp.log(w_safe))
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, n, sm_scale, block_q, block_k):
+    o, _ = _ring_flash_fwd(q, k, v, axis_name, n, sm_scale, block_q,
+                           block_k)
+    return o
+
+
+def _ring_flash_fwd(q, k, v, axis_name, n, sm_scale, block_q, block_k):
+    from dlrover_tpu.ops.attention import STATS_W, ring_fwd_block
+
+    idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+
+    from dlrover_tpu.parallel.pipeline import _gated
+
+    def step(carry, t):
+        kv_cur, o_acc, lse_acc = carry
+        kv_chunk = (idx - t) % n
+
+        def _visible(kv):
+            o_blk, lse_blk = ring_fwd_block(
+                q, kv[0], kv[1], idx * sq, kv_chunk * sk, sm_scale,
+                block_q=block_q, block_k=block_k,
+            )
+            return o_blk.astype(jnp.float32), lse_blk[..., :1]
+
+        def _future(kv):
+            return (jnp.zeros((b, h, sq, d), jnp.float32),
+                    jnp.full((b, h, sq, 1), NEG_INF, jnp.float32))
+
+        o_blk, lse_blk = _gated(
+            kv_chunk <= idx, _visible, _future, kv_cur)
+        o_acc, lse_acc = _merge_block(o_acc, lse_acc, o_blk, lse_blk)
+        return (lax.ppermute(kv_cur, axis_name, perm), o_acc,
+                lse_acc), None
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    lse0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
+    (_, o, lse), _ = lax.scan(
+        step, (jnp.stack([k, v]), o0, lse0), jnp.arange(n), length=n)
+    lse_w = jnp.broadcast_to(lse, lse.shape[:-1] + (STATS_W,))
+    return o.astype(q.dtype), (q, k, v, o.astype(q.dtype), lse_w)
+
+
+def _ring_flash_bwd(axis_name, n, sm_scale, block_q, block_k, res, do):
+    from dlrover_tpu.ops.attention import (
+        STATS_W, ring_dkv_block, ring_dq_block,
+    )
+
+    q, k, v, o, lse = res
+    idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    sq, sk = q.shape[2], k.shape[2]
+    dof = do.astype(jnp.float32) * o.astype(jnp.float32)
+    delta = jnp.broadcast_to(
+        dof.sum(-1, keepdims=True), lse.shape[:-1] + (STATS_W,))
+
+    from dlrover_tpu.parallel.pipeline import _gated
+
+    def step(carry, t):
+        kv_cur, dkv_cur, dq_acc = carry
+        k_cur, v_cur = kv_cur[0], kv_cur[1]
+        kv_chunk = (idx - t) % n
+
+        def _visible(kv):
+            dqb = ring_dq_block(
+                q, kv[0], kv[1], do, lse, delta, idx * sq,
+                kv_chunk * sk, sm_scale, block_q=block_q,
+                block_k=block_k,
+            )
+            dkb, dvb = ring_dkv_block(
+                q, kv[0], kv[1], do, lse, delta, idx * sq,
+                kv_chunk * sk, sm_scale, block_q=block_q,
+                block_k=block_k,
+            )
+            return dqb, jnp.stack([dkb, dvb])
+
+        def _future(kv):
+            return (jnp.zeros(q.shape, jnp.float32),
+                    jnp.zeros((2,) + k.shape, jnp.float32))
+
+        dqb, dkvb = _gated(kv_chunk <= idx, _visible, _future, kv_cur)
+        dq_acc = dq_acc + dqb
+        dkv_cur = dkv_cur + dkvb
+        # two stacked permutes (kv in model dtype, cotangents in f32):
+        # the barrier serializes them — XLA:CPU may otherwise reorder
+        # independent collectives across devices and deadlock the mesh
+        kv_next = lax.ppermute(kv_cur, axis_name, perm)
+        kv_next, dkv_cur = lax.optimization_barrier((kv_next, dkv_cur))
+        dkv_next = lax.ppermute(dkv_cur, axis_name, perm)
+        return (kv_next, dkv_next, dq_acc), None
+
+    dkv0 = jnp.zeros((2,) + k.shape, jnp.float32)
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    (_, dkv, dq), _ = lax.scan(
+        step, (jnp.stack([k, v]), dkv0, dq0), jnp.arange(n), length=n)
+    return (dq.astype(q.dtype), dkv[0].astype(k.dtype),
+            dkv[1].astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(
     q, k, v,
     axis_name: str = "seq",
     axis_size: Optional[int] = None,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    use_kernel: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
 ):
     """Ring attention over a named mesh axis (call inside shard_map).
 
@@ -89,6 +234,10 @@ def ring_attention(
       axis_name: mesh axis the sequence is sharded over.
       axis_size: static ring size; defaults to the active mesh's axis size
         (must be static — it is the scan length).
+      use_kernel: run each visiting block through the packed Pallas
+        flash kernel (interpret mode on CPU); the einsum block remains
+        as the fallback for non-causal rings and head dims the hardware
+        kernels cannot tile (head_dim % 128 on TPU).
     Returns the attention output shard, same shape/dtype as q.
     """
     if axis_size is None:
@@ -96,7 +245,17 @@ def ring_attention(
     n = int(axis_size)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    kernel_ok = use_kernel and causal and (
+        _use_interpret() or q.shape[-1] % 128 == 0
+    )
+    if kernel_ok and n > 1:
+        return _ring_flash(q, k, v, axis_name, n, float(sm_scale),
+                           int(block_q), int(block_k))
     if n == 1:
+        if kernel_ok:
+            return flash_attention(
+                q, k, v, causal=True, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k)
         o, _, l = _block_attn(q, k, v, 0, 0, sm_scale, causal)
         l = jnp.where(l == 0.0, 1.0, l)
         return (o / l).astype(q.dtype)

@@ -158,3 +158,65 @@ def test_sequence_sharded_attention_wrapper():
                                    rtol=2e-5, atol=2e-5)
     finally:
         mesh_mod._global_mesh = None
+
+
+def test_ring_kernel_path_is_taken(monkeypatch):
+    """Causal rings must route through the Pallas block kernels
+    (VERDICT r3 #7), not the einsum fallback."""
+    import dlrover_tpu.ops.attention as attn_mod
+    import dlrover_tpu.parallel.sequence as seq_mod
+
+    calls = {"n": 0}
+    real = attn_mod.ring_fwd_block
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(attn_mod, "ring_fwd_block", counting)
+    mesh = seq_mesh(4)
+    q, k, v = make_qkv()
+    fn = shard_map(
+        functools.partial(seq_mod.ring_attention, axis_name="seq",
+                          axis_size=4),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None),
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, P(None, None, "seq", None))
+    out = jax.jit(fn)(*(jax.device_put(x, sharding) for x in (q, k, v)))
+    jax.block_until_ready(out)
+    assert calls["n"] > 0
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_kernel_grads_match_gqa():
+    """Kernel-ring gradients (custom VJP: second ring pass through the
+    dq/dkv kernels with GLOBAL lse/delta) vs dense reference, with
+    grouped kv heads."""
+    mesh = seq_mesh(4)
+    q, k, v = make_qkv(b=1, h=4, s=32, d=16, kv_heads=2, seed=3)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(jnp.sin(mha_reference(q, k, v, causal=True)))
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="seq", axis_size=4),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None),
+        check_vma=False,
+    )
+
+    def ring_loss(q, k, v):
+        return jnp.sum(jnp.sin(fn(q, k, v)))
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    with mesh:
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
