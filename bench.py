@@ -79,6 +79,48 @@ def main():
     step_time = (time.perf_counter() - t0) / steps
     tokens_per_sec = batch * seq / step_time
 
+    # online per-kernel attribution (reference xpu_timer's named-kernel
+    # Prometheus export): profile a short window, publish the top ops,
+    # and serve them from the agent's /metrics endpoint
+    top_ops, kernel_metrics_served = [], False
+    prof_dir = tempfile.mkdtemp(prefix="bench_prof_")
+    try:
+        from dlrover_tpu.agent.monitor import MetricsEndpoint
+        from dlrover_tpu.common.constants import ConfigPath
+        from dlrover_tpu.trainer.profiler import StepProfiler
+
+        kpath = os.environ.get(
+            ConfigPath.ENV_KERNEL_METRICS, ConfigPath.KERNEL_METRICS)
+        if os.path.exists(kpath):
+            os.unlink(kpath)  # a stale file must not fake the signal
+        prof = StepProfiler(prof_dir, start_step=0, num_steps=2,
+                            publish_top_ops=True)
+        for i in range(2):
+            prof.maybe_start(i)
+            state, m = res.train_step(
+                state, {"tokens": tokens}, jax.random.key(500 + i))
+            prof.maybe_stop(i, block_on=m["loss"])
+        endpoint = MetricsEndpoint(exporter=None, host="127.0.0.1")
+        port = endpoint.start()
+        try:
+            import urllib.request
+
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            kernel_metrics_served = "dlrtpu_kernel_self_ms" in body
+        finally:
+            endpoint.stop()
+        import json as _json
+
+        if os.path.exists(kpath):
+            with open(kpath) as f:
+                top_ops = _json.load(f).get("top_ops", [])[:5]
+    except Exception:  # noqa: BLE001 - profiling is best-effort
+        pass
+    finally:
+        shutil.rmtree(prof_dir, ignore_errors=True)
+
     # device<->host link bandwidth, measured in isolation so the
     # D2H/H2D-dependent numbers below are interpretable: on a remote
     # tunnel these reflect the link, not the checkpoint engine.
@@ -239,6 +281,14 @@ def main():
 
     import dataclasses as _dc
 
+    # the main run's train state / snapshot / restored host copies are
+    # no longer needed — free HBM+host before compiling the comparison
+    # arms (the int8 arm's int32 accumulators otherwise OOM the chip)
+    del state, snap, host_state, loaded, loaded_copy, res
+    import gc as _gc
+
+    _gc.collect()
+
     sched_steps = 8 if on_tpu else 2
     t_1f1b = _step_time_for(
         _dc.replace(config, pipe_schedule="1f1b", pipe_microbatches=4),
@@ -291,6 +341,8 @@ def main():
             # the dtype auto_accelerate actually recommends/selects on
             # this hardware (low-precision modes are warn-gated)
             "selected_compute_dtype": "bfloat16",
+            "kernel_metrics_served": kernel_metrics_served,
+            "top_ops": top_ops,
             "backend": jax.default_backend(),
         },
     }))

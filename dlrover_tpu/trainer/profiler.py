@@ -55,10 +55,12 @@ class StepProfiler:
     profiling steps [start, start+num) once warmup is done)."""
 
     def __init__(self, log_dir: str, start_step: int = 10,
-                 num_steps: int = 3):
+                 num_steps: int = 3, publish_top_ops: bool = False):
         self.log_dir = log_dir
         self.start_step = int(start_step)
         self.stop_step = int(start_step) + int(num_steps)
+        self.num_steps = int(num_steps)
+        self.publish_top_ops = publish_top_ops
         self._active = False
         self._done = False
 
@@ -93,7 +95,96 @@ class StepProfiler:
         self._active = False
         self._done = True
         logger.info("profile window complete: %s", self.log_dir)
+        if self.publish_top_ops:
+            # divide by the steps actually captured: close() can end
+            # the window early (step < stop_step)
+            captured = max(
+                min(step, self.stop_step - 1) - self.start_step + 1, 1)
+            try:
+                publish_kernel_stats(
+                    self.log_dir, steps=captured)
+            except Exception:  # noqa: BLE001 - stats are best-effort
+                logger.warning("per-op stats publish failed",
+                               exc_info=True)
 
     def close(self):
         if self._active:
             self.maybe_stop(self.stop_step)
+
+
+def top_ops_from_trace(log_dir: str, k: int = 15,
+                       steps: int = 1) -> list[dict]:
+    """Parse the newest XPlane trace under ``log_dir`` into the top-k
+    HLO ops by total self time.
+
+    The online half of xpu_timer's per-kernel attribution (reference
+    atorch/dev/xpu_timer/xpu_timer/common/manager.cc exports named
+    kernel histograms over brpc/Prometheus): the offline
+    tools/parse_profile.py logic, packaged so the agent can surface
+    per-op timings on its /metrics endpoint between checkpoint windows.
+    Returns [{"op", "category", "self_ms_per_step"}] (divided by
+    ``steps``, the number of profiled steps in the window).
+    """
+    import glob
+    import json as _json
+
+    paths = sorted(glob.glob(
+        os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True))
+    if not paths:
+        return []
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+
+        data, _ = rtd.xspace_to_tool_data([paths[-1]], "hlo_stats", {})
+        if isinstance(data, bytes):
+            data = data.decode()
+        obj = _json.loads(data)
+    except Exception:  # noqa: BLE001 - xprof optional / format drift
+        # (some xprof versions emit CSV here, not gviz JSON)
+        logger.warning("xprof unavailable; no per-op stats", exc_info=True)
+        return []
+    cols = [c["label"] for c in obj["cols"]]
+    try:
+        icat = cols.index("HLO op category")
+        iname = cols.index("HLO op name")
+        itime = cols.index("Total self time (us)")
+    except ValueError:
+        return []
+    agg: dict = {}
+    for row in obj["rows"]:
+        vals = [c["v"] for c in row["c"]]
+        t = float(vals[itime] or 0)
+        key = (str(vals[icat]), str(vals[iname]))
+        agg[key] = agg.get(key, 0.0) + t
+    top = sorted(agg.items(), key=lambda kv: -kv[1])[:k]
+    return [
+        {
+            "op": name,
+            "category": cat,
+            "self_ms_per_step": round(t / max(steps, 1) / 1e3, 4),
+        }
+        for (cat, name), t in top
+    ]
+
+
+def publish_kernel_stats(log_dir: str, k: int = 15, steps: int = 1,
+                         out_path: str | None = None) -> list[dict]:
+    """Parse + atomically publish top-op stats where the agent's
+    Prometheus endpoint picks them up (ConfigPath.KERNEL_METRICS)."""
+    import json as _json
+
+    from dlrover_tpu.common.constants import ConfigPath
+
+    ops = top_ops_from_trace(log_dir, k=k, steps=steps)
+    if not ops:
+        return ops
+    path = out_path or os.environ.get(
+        ConfigPath.ENV_KERNEL_METRICS, ConfigPath.KERNEL_METRICS
+    )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"  # concurrent workers publish too
+    with open(tmp, "w") as f:
+        _json.dump({"top_ops": ops}, f)
+    os.replace(tmp, path)
+    logger.info("published %d per-op timings to %s", len(ops), path)
+    return ops

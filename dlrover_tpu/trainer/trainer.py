@@ -140,6 +140,10 @@ class Trainer:
                 os.path.join(args.output_dir, "profile"),
                 start_step=args.profile_start_step,
                 num_steps=args.profile_num_steps,
+                # publish top-op self times where the agent's /metrics
+                # endpoint serves them (dlrtpu_kernel_self_ms) — the
+                # online per-kernel attribution, not just trace files
+                publish_top_ops=True,
             )
 
     # -------------------------------------------------------------- resume

@@ -1,0 +1,144 @@
+"""Goodput under injected preemption (BASELINE ladder #5 rehearsal).
+
+The reference's headline fault-tolerance claim is goodput 69% -> 95%+
+(dlrover README: flash checkpoint + elastic restart make preemptions
+cheap). This e2e reproduces the scenario on the local agent stack:
+
+1. a worker trains with per-step flash checkpoints into shm,
+2. it is KILLED mid-run (injected preemption, no cleanup),
+3. the agent restarts it; the new incarnation resumes from shm,
+4. goodput is computed the way bench.py computes it — useful time over
+   useful time plus the measured loss — where the loss per preemption
+   is (restart latency + replayed work), amortized at the reference's
+   production preemption cadence.
+
+Emits a JSON artifact (GOODPUT_PREEMPTION.json next to the test's tmp
+dir; also to the repo root when DLRTPU_WRITE_ARTIFACTS=1) and asserts
+goodput >= 95%.
+"""
+
+import json
+import os
+import time
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training_agent import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    WorkerSpec,
+)
+from dlrover_tpu.common.constants import NodeType
+
+# one preemption per hour: the spot-instance cadence the reference's
+# 69% -> 95% goodput comparison is drawn against (their low-goodput
+# baseline loses ~10 min of replay + restart per event)
+PREEMPTION_PERIOD_S = 3600.0
+
+WORKER = """
+import json, os, time
+import jax.numpy as jnp
+from dlrover_tpu.trainer.flash_checkpoint.engine import (
+    ReplicatedCheckpointEngine,
+)
+
+out_dir = os.environ["GOODPUT_OUT_DIR"]
+engine = ReplicatedCheckpointEngine(out_dir + "/ckpt")
+
+restored = engine.load()
+if restored is None:
+    start, w = 0, jnp.zeros((4,))
+else:
+    start = int(restored["step"])
+    w = jnp.asarray(list(restored["state"].values())[0])
+
+TOTAL, CRASH_AT, STEP_S = 12, 6, 0.05
+with open(out_dir + f"/steps_{os.getpid()}.jsonl", "a") as log:
+    for step in range(start + 1, TOTAL + 1):
+        time.sleep(STEP_S)  # simulated device work
+        w = w + 1.0
+        engine.save_to_memory(step, {"w": w})
+        log.write(json.dumps(
+            {"step": step, "t": time.time(), "start": start}) + "\\n")
+        log.flush()
+        if step == CRASH_AT and restored is None:
+            os._exit(13)  # injected preemption, no cleanup
+
+with open(out_dir + "/result.json", "w") as f:
+    json.dump({"resumed_from": start, "final_w0": float(w[0]),
+               "step_s": STEP_S, "crash_at": CRASH_AT}, f)
+engine.close()
+"""
+
+
+def test_goodput_under_one_preemption(local_master, tmp_path, monkeypatch,
+                                      isolated_ckpt_env):
+    script = tmp_path / "goodput_worker.py"
+    script.write_text(WORKER)
+    monkeypatch.setenv("GOODPUT_OUT_DIR", str(tmp_path))
+
+    config = ElasticLaunchConfig(
+        min_nodes=1, max_nodes=1, nproc_per_node=1,
+        monitor_interval=0.2, rdzv_timeout=30, max_restarts=2,
+        log_dir=str(tmp_path),
+    )
+    client = MasterClient(local_master.addr, 0, NodeType.WORKER)
+    agent = ElasticTrainingAgent(
+        config, WorkerSpec(str(script), (), config), client)
+    t0 = time.time()
+    try:
+        assert agent.run() == 0
+    finally:
+        client.close()
+    wall = time.time() - t0
+
+    result = json.loads((tmp_path / "result.json").read_text())
+    # every step ran exactly once (resume from the shm ckpt taken just
+    # before the kill — zero replay)
+    assert result["resumed_from"] == result["crash_at"], result
+    assert result["final_w0"] == 12.0, result
+
+    # reconstruct the preemption cost from the step logs: time between
+    # the last pre-crash step and the first post-restart step, minus
+    # one step of useful work
+    events = []
+    for p in tmp_path.glob("steps_*.jsonl"):
+        for line in p.read_text().splitlines():
+            events.append(json.loads(line))
+    events.sort(key=lambda e: e["t"])
+    steps = {e["step"]: e for e in events}
+    crash_at = result["crash_at"]
+    step_s = result["step_s"]
+    restart_gap = steps[crash_at + 1]["t"] - steps[crash_at]["t"]
+    lost_s = max(restart_gap - step_s, 0.0)
+    replayed = max(crash_at - result["resumed_from"], 0) * step_s
+    # goodput at the production preemption cadence, computed the way
+    # bench.py amortizes the checkpoint pause over its interval
+    goodput = PREEMPTION_PERIOD_S / (
+        PREEMPTION_PERIOD_S + lost_s + replayed)
+
+    artifact = {
+        "metric": "goodput_under_preemption",
+        "value": round(goodput * 100, 3),
+        "unit": "%",
+        "vs_baseline": round(goodput / 0.95, 4),
+        "detail": {
+            "restart_latency_s": round(lost_s, 3),
+            "replayed_work_s": round(replayed, 3),
+            "preemption_period_s": PREEMPTION_PERIOD_S,
+            "resumed_from_step": result["resumed_from"],
+            "crash_at_step": crash_at,
+            "total_wall_s": round(wall, 3),
+            "recovery": "shm flash checkpoint (zero replay)",
+        },
+    }
+    (tmp_path / "GOODPUT_PREEMPTION.json").write_text(
+        json.dumps(artifact, indent=2))
+    if os.environ.get("DLRTPU_WRITE_ARTIFACTS") == "1":
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(repo, "GOODPUT_PREEMPTION.json"), "w") as f:
+            json.dump(artifact, f, indent=2)
+
+    assert goodput >= 0.95, artifact
+    # the restart must be seconds, not minutes (the reference's 69%
+    # baseline loses ~10 min/event)
+    assert lost_s < 60.0, artifact
