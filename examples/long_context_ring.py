@@ -1,0 +1,82 @@
+"""Long-context training with ring attention over a ``seq`` mesh axis.
+
+The sequence dimension is sharded across devices; each device keeps its
+q shard resident while k/v rotate around the ring (`lax.ppermute`), and
+every visiting block runs the packed Pallas flash kernel with dynamic
+global-position causal masks (parallel/sequence.py). On hardware the
+permutes ride ICI neighbour links; here the virtual CPU mesh
+demonstrates the schedule end-to-end — the same code runs unchanged on
+a real TPU slice.
+
+Reference capability: atorch DistributedSelfAttention
+(distributed_attention.py:79) + its sequence-parallel examples.
+
+Usage::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context_ring.py --steps 3
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=3)
+    parser.add_argument("--seq-len", type=int, default=512)
+    parser.add_argument("--batch-size", type=int, default=2)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models import (
+        llama_init,
+        llama_logical_axes,
+        llama_loss_fn,
+    )
+    from dlrover_tpu.models.llama import LlamaConfig
+    from dlrover_tpu.parallel import MeshConfig, Strategy, auto_accelerate
+
+    n = len(jax.devices())
+    seq_shards = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    config = LlamaConfig(
+        vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=128, max_seq_len=args.seq_len, attn_impl="flash",
+        remat=False, dtype="float32",
+    )
+    strategy = Strategy(
+        mesh=MeshConfig(data=n // seq_shards, seq=seq_shards),
+        compute_dtype=None, remat="none",
+    )
+    res = auto_accelerate(
+        llama_loss_fn(config),
+        lambda rng: llama_init(config, rng),
+        optax.adamw(1e-3),
+        llama_logical_axes(config),
+        strategy=strategy,
+    )
+    print(f"mesh: data={n // seq_shards} x seq={seq_shards}, "
+          f"sequence {args.seq_len} sharded {args.seq_len // seq_shards}"
+          f"/device (ring attention)")
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(
+        0, config.vocab_size, (args.batch_size, args.seq_len + 1)))
+    state = res.state
+    for step in range(args.steps):
+        state, metrics = res.train_step(
+            state, {"tokens": tokens}, jax.random.key(step))
+        print(f"step {step}: loss={float(metrics['loss']):.4f}")
+    assert np.isfinite(float(metrics["loss"]))
+    print("ring-attention training ok")
+
+
+if __name__ == "__main__":
+    main()

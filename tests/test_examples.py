@@ -55,6 +55,7 @@ def _cleanup_job_shm(job):
     ["examples/kv_ctr_train.py", "--steps", "50"],
     ["examples/ppo_rlhf.py", "--iterations", "3"],
     ["examples/coworker_pipeline.py"],
+    ["examples/long_context_ring.py", "--steps", "2"],
 ])
 def test_example_runs(args, tmp_path):
     # per-test job name: the subprocesses' persistent checkpoint/timer
