@@ -1061,6 +1061,188 @@ def _delta_bhsd(do, o, block_q, interpret):
     )(do, o)
 
 
+def _group_kv(dk_full, dv_full, batch, KVH, group, kv_len,
+              head_dim, k_dtype, v_dtype):
+    """GQA tail shared by the backward paths: per-q-head dk/dv are
+    group-summed down to kv-head shapes."""
+    if group == 1:
+        return dk_full, dv_full
+    dk = dk_full.reshape(
+        batch, KVH, group, kv_len, head_dim).sum(axis=2).astype(k_dtype)
+    dv = dv_full.reshape(
+        batch, KVH, group, kv_len, head_dim).sum(axis=2).astype(v_dtype)
+    return dk, dv
+
+
+def _bwd_onepass_kernel(
+    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
+    n_tiles, rope=False,
+):
+    """Fused dq+dk+dv backward (kv-major packed grid).
+
+    The split dq/dkv kernels each recompute s, p and dp per tile — 7
+    large matmuls and two softmax chains where 5 and one suffice. TPU
+    grids execute SEQUENTIALLY, so dq can accumulate across the whole
+    (b, h) walk in a full-length VMEM scratch ([q_len, Dh] f32 — 1 MB at
+    2048x128) written out once at the final tile; dk/dv accumulate per
+    kv column exactly like the split kernel. ~29% of backward MXU work
+    and one of the two exp(s - lse) chains disappear.
+    """
+    if rope:
+        (cq_ref, sq_ref, ck_ref, sk_ref,
+         dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr, kr_scr) = rest
+    else:
+        (dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr) = rest
+    t = pl.program_id(2)
+    i = meta_ref[0, t]
+    j = meta_ref[1, t]
+
+    @pl.when(t == 0)
+    def _zero_dq():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(meta_ref[2, t] == 1)
+    def _col_init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+        if rope:
+            # kv-major: the k column stays resident across its q visits
+            kr_scr[:] = _rope_tile(_t2(k_ref), ck_ref, sk_ref)
+
+    def _tile(masked):
+        # scaled-q trick: the scaled q serves s = (q*scale)@k and
+        # dk += ds^T (q*scale); dq takes one final *scale instead
+        q = _t2(q_ref)
+        if rope:
+            q = _rope_tile(q, cq_ref, sq_ref)
+            k = kr_scr[:]
+        else:
+            k = _t2(k_ref)
+        q = _zero_pad_rows(q, i, block_q, q_len)
+        q = q * jnp.asarray(sm_scale, q.dtype)
+        v = _t2(v_ref)
+        do = _zero_pad_rows(_t2(do_ref), i, block_q, q_len)
+        lse = _col(lse_ref)
+        delta = _zero_pad_rows(_col(delta_ref), i, block_q, q_len)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        mask = None
+        if masked:
+            mask = _block_mask(
+                s.shape, i, j, block_q=block_q, block_k=block_k,
+                causal=causal, q_len=q_len, kv_len=kv_len,
+            )
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if mask is not None and p_zero:
+            p = jnp.where(mask, p, 0.0)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dsk = jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        row = pl.dslice(i * block_q, block_q)
+        dq_scr[row, :] = dq_scr[row, :] + dsk
+
+    _dispatch_tile(_tile, i, j, causal=causal, block_q=block_q,
+                   block_k=block_k, q_len=q_len, kv_len=kv_len)
+
+    @pl.when(meta_ref[3, t] == 1)
+    def _col_final():
+        dk = dk_scr[:]
+        if rope:
+            dk = _unrope_tile(dk, ck_ref, sk_ref)
+        _wr(dk_ref, dk)
+        _wr(dv_ref, dv_scr[:])
+
+    @pl.when(t == n_tiles - 1)
+    def _dq_final():
+        # rope: dq leaves ROPED; the caller un-ropes in XLA (a full
+        # [q_len, Dh] cos/sin block pair here pushed the kernel ~1 MB
+        # past the 16 MB scoped-vmem limit at 1024 blocks)
+        _wr(dq_ref, dq_scr[:] * sm_scale)
+
+
+def _bwd_onepass(layout, H, KVH, q_len, kv_len, head_dim, sm_scale,
+                 causal, block_q, block_k, interpret, q, k, v, do, lse,
+                 delta, rope_cos, rope_sin):
+    """Fused-backward pallas call (bhsd layout, kv-major packed grid)."""
+    batch = q.shape[0]
+    group = H // KVH
+    nq = pl.cdiv(q_len, block_q)
+    nk = pl.cdiv(kv_len, block_k)
+    rope = rope_cos is not None
+    meta = jnp.asarray(_tile_meta(
+        nq, nk, block_q, block_k, q_len, kv_len, causal, True))
+    q_spec, kv_spec, row_spec = _io_specs(
+        layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
+        group=group)
+    kv_out_spec = _kv_out(layout, block_k=block_k, head_dim=head_dim)
+    dq_spec = pl.BlockSpec(
+        (1, 1, q_len, head_dim), lambda b, h, t, m: (b, h, 0, 0))
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
+    operands = [q, k, v, do, lse, delta]
+    scratch = [
+        pltpu.VMEM((q_len, head_dim), jnp.float32),
+        pltpu.VMEM((block_k, head_dim), jnp.float32),
+        pltpu.VMEM((block_k, head_dim), jnp.float32),
+    ]
+    if rope:
+        in_specs += _rope_specs(block_q, block_k, head_dim)
+        operands += [rope_cos, rope_sin, rope_cos, rope_sin]
+        scratch.append(pltpu.VMEM((block_k, head_dim), k.dtype))
+    dq, dk_full, dv_full = pl.pallas_call(
+        functools.partial(
+            _bwd_onepass_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_len=q_len,
+            kv_len=kv_len,
+            p_zero=_needs_p_zero(causal, block_q, block_k, q_len,
+                                 kv_len),
+            n_tiles=int(meta.shape[1]), rope=rope,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, H, meta.shape[1]),
+            in_specs=in_specs,
+            out_specs=(dq_spec, kv_out_spec, kv_out_spec),
+            scratch_shapes=scratch,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, H, kv_len, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch, H, kv_len, head_dim), q.dtype),
+        ),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(meta, *operands)
+    if rope:
+        # transpose-of-rope in XLA (see _unrope_tile): g*C - (g*S)@P
+        c = rope_cos[:, None].astype(jnp.float32)
+        s = rope_sin[:, None].astype(jnp.float32)
+        rot_p = _rope_rot_mat(head_dim, jnp.float32)
+        dqf = dq.astype(jnp.float32)
+        dq = (dqf * c - jnp.einsum(
+            "bhsd,de->bhse", dqf * s, rot_p)).astype(dq.dtype)
+    return dq, dk_full, dv_full
+
+
 def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
          interpret, res, do, rope_cos=None, rope_sin=None):
     if layout == "bshdf":
@@ -1086,6 +1268,25 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
         delta = dof.reshape(batch, q_len, H, head_dim).sum(-1)
         delta = delta.transpose(0, 2, 1)[..., None]
         delta = jnp.broadcast_to(delta, delta.shape[:-1] + (STATS_W,))
+
+    # fused one-pass backward: dq accumulates in a full-length VMEM
+    # scratch — gated on the scratch fitting comfortably and on
+    # block-aligned lengths (a padded final tile's row slice would run
+    # past the exact-length scratch)
+    # conservative gate: 2048x128 at 1024 blocks measured ~1 MB under
+    # the 16 MB scoped-vmem cap; larger dq scratches / output blocks
+    # would tip Mosaic over with no fallback, so only shapes at or
+    # below the proven footprint take the fused path
+    if (layout == "bhsd" and q_len * head_dim <= 2048 * 128
+            and q_len % block_q == 0 and kv_len % block_k == 0):
+        dq, dk_full, dv_full = _bwd_onepass(
+            layout, H, KVH, q_len, kv_len, head_dim, sm_scale, causal,
+            block_q, block_k, interpret, q, k, v, do, lse, delta,
+            rope_cos, rope_sin,
+        )
+        dk, dv = _group_kv(dk_full, dv_full, batch, KVH, group,
+                           kv_len, head_dim, k.dtype, v.dtype)
+        return dq, dk, dv
 
     q_spec, kv_spec, row_spec = _io_specs(
         layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
@@ -1165,23 +1366,18 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
         interpret=interpret,
     )(meta_kv, q, k, v, do, lse, delta, *rope_operands)
 
-    if group > 1:
-        if layout == "bhsd":
-            dk = dk_full.reshape(
-                batch, KVH, group, kv_len, head_dim).sum(axis=2)
-            dv = dv_full.reshape(
-                batch, KVH, group, kv_len, head_dim).sum(axis=2)
-        else:
-            dk = dk_full.reshape(
-                batch, kv_len, KVH, group, head_dim
-            ).sum(axis=3).reshape(batch, kv_len, KVH * head_dim)
-            dv = dv_full.reshape(
-                batch, kv_len, KVH, group, head_dim
-            ).sum(axis=3).reshape(batch, kv_len, KVH * head_dim)
-        dk = dk.astype(k.dtype)
-        dv = dv.astype(v.dtype)
+    if group > 1 and layout != "bhsd":
+        dk = dk_full.reshape(
+            batch, kv_len, KVH, group, head_dim
+        ).sum(axis=3).reshape(batch, kv_len, KVH * head_dim).astype(
+            k.dtype)
+        dv = dv_full.reshape(
+            batch, kv_len, KVH, group, head_dim
+        ).sum(axis=3).reshape(batch, kv_len, KVH * head_dim).astype(
+            v.dtype)
     else:
-        dk, dv = dk_full, dv_full
+        dk, dv = _group_kv(dk_full, dv_full, batch, KVH, group, kv_len,
+                           head_dim, k.dtype, v.dtype)
     return dq, dk, dv
 
 
