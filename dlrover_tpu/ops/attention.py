@@ -1075,7 +1075,7 @@ def _group_kv(dk_full, dv_full, batch, KVH, group, kv_len,
 
 
 def _bwd_onepass_kernel(
-    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    meta_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, *rest,
     sm_scale, causal, block_q, block_k, q_len, kv_len, p_zero,
     n_tiles, rope=False,
 ):
@@ -1124,7 +1124,13 @@ def _bwd_onepass_kernel(
         v = _t2(v_ref)
         do = _zero_pad_rows(_t2(do_ref), i, block_q, q_len)
         lse = _col(lse_ref)
-        delta = _zero_pad_rows(_col(delta_ref), i, block_q, q_len)
+        # delta = rowsum(do * o) computed in place of a separate
+        # mini-kernel: the per-visit (bq, Dh) mult+reduce is trivial
+        # VPU work, and the delta tensor (plus its launch and wide-
+        # stats traffic) disappears from the backward entirely
+        o_t = _t2(o_ref).astype(jnp.float32)
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_t, axis=-1, keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -1181,7 +1187,7 @@ def _bwd_onepass_kernel(
 
 def _bwd_onepass(layout, H, KVH, q_len, kv_len, head_dim, sm_scale,
                  causal, block_q, block_k, interpret, q, k, v, do, lse,
-                 delta, rope_cos, rope_sin):
+                 o, rope_cos, rope_sin):
     """Fused-backward pallas call (bhsd layout, kv-major packed grid)."""
     batch = q.shape[0]
     group = H // KVH
@@ -1196,8 +1202,8 @@ def _bwd_onepass(layout, H, KVH, q_len, kv_len, head_dim, sm_scale,
     kv_out_spec = _kv_out(layout, block_k=block_k, head_dim=head_dim)
     dq_spec = pl.BlockSpec(
         (1, 1, q_len, head_dim), lambda b, h, t, m: (b, h, 0, 0))
-    in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
-    operands = [q, k, v, do, lse, delta]
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, q_spec]
+    operands = [q, k, v, do, lse, o]
     scratch = [
         pltpu.VMEM((q_len, head_dim), jnp.float32),
         pltpu.VMEM((block_k, head_dim), jnp.float32),
@@ -1260,6 +1266,26 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
     nq = pl.cdiv(q_len, block_q)
     nk = pl.cdiv(kv_len, block_k)
 
+    # fused one-pass backward: dq accumulates in a full-length VMEM
+    # scratch — gated on the scratch fitting comfortably and on
+    # block-aligned lengths (a padded final tile's row slice would run
+    # past the exact-length scratch). Conservative: 2048x128 at 1024
+    # blocks measured ~1 MB under the 16 MB scoped-vmem cap; larger dq
+    # scratches / output blocks would tip Mosaic over with no fallback,
+    # so only shapes at or below the proven footprint take this path.
+    # delta is computed per visit INSIDE the kernel (from o), so the
+    # separate delta tensor never exists on this path.
+    if (layout == "bhsd" and q_len * head_dim <= 2048 * 128
+            and q_len % block_q == 0 and kv_len % block_k == 0):
+        dq, dk_full, dv_full = _bwd_onepass(
+            layout, H, KVH, q_len, kv_len, head_dim, sm_scale, causal,
+            block_q, block_k, interpret, q, k, v, do, lse, o,
+            rope_cos, rope_sin,
+        )
+        dk, dv = _group_kv(dk_full, dv_full, batch, KVH, group,
+                           kv_len, head_dim, k.dtype, v.dtype)
+        return dq, dk, dv
+
     # delta = rowsum(do * o) per head, dense [B, H, S, STATS_W]
     if layout == "bhsd":
         delta = _delta_bhsd(do, o, block_q, interpret)
@@ -1268,25 +1294,6 @@ def _bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
         delta = dof.reshape(batch, q_len, H, head_dim).sum(-1)
         delta = delta.transpose(0, 2, 1)[..., None]
         delta = jnp.broadcast_to(delta, delta.shape[:-1] + (STATS_W,))
-
-    # fused one-pass backward: dq accumulates in a full-length VMEM
-    # scratch — gated on the scratch fitting comfortably and on
-    # block-aligned lengths (a padded final tile's row slice would run
-    # past the exact-length scratch)
-    # conservative gate: 2048x128 at 1024 blocks measured ~1 MB under
-    # the 16 MB scoped-vmem cap; larger dq scratches / output blocks
-    # would tip Mosaic over with no fallback, so only shapes at or
-    # below the proven footprint take the fused path
-    if (layout == "bhsd" and q_len * head_dim <= 2048 * 128
-            and q_len % block_q == 0 and kv_len % block_k == 0):
-        dq, dk_full, dv_full = _bwd_onepass(
-            layout, H, KVH, q_len, kv_len, head_dim, sm_scale, causal,
-            block_q, block_k, interpret, q, k, v, do, lse, delta,
-            rope_cos, rope_sin,
-        )
-        dk, dv = _group_kv(dk_full, dv_full, batch, KVH, group,
-                           kv_len, head_dim, k.dtype, v.dtype)
-        return dq, dk, dv
 
     q_spec, kv_spec, row_spec = _io_specs(
         layout, block_q=block_q, block_k=block_k, head_dim=head_dim,
