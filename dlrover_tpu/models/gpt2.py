@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
-from dlrover_tpu.ops.fp8 import qdot
+from dlrover_tpu.ops.fp8 import qdot, qeinsum
 from dlrover_tpu.parallel.sharding import shard_logical
 
 
@@ -165,9 +165,9 @@ def _block(config: GPT2Config, x, p):
         # trick as llama's _layer; gate + dispatch shared via llama)
         w4 = p["w_qkv"].astype(dtype).reshape(D, 3, h, hd)
         b4 = p["b_qkv"].astype(dtype).reshape(3, 1, h, 1, hd)
-        qkv4 = jnp.einsum("bsd,dthk->tbhsk", y, w4) + b4
+        qkv4 = qeinsum("bsd,dthk->tbhsk", y, w4) + b4
         out = bhsd_flash_attention(config, qkv4[0], qkv4[1], qkv4[2])
-        attn_out = jnp.einsum(
+        attn_out = qeinsum(
             "bhsk,hkd->bsd", out,
             p["w_proj"].astype(dtype).reshape(h, hd, D))
         x = x + attn_out + p["b_proj"].astype(dtype)

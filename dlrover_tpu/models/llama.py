@@ -32,7 +32,7 @@ from dlrover_tpu.ops.attention import (
     mha_reference,
 )
 from dlrover_tpu.ops.cross_entropy import softmax_cross_entropy
-from dlrover_tpu.ops.fp8 import fp8_enabled, qdot
+from dlrover_tpu.ops.fp8 import qdot, qeinsum, quant_mode
 from dlrover_tpu.parallel.sharding import shard_logical
 
 
@@ -364,11 +364,15 @@ def _sharded_flash(config: LlamaConfig, qt, kt, vt, layout: str = "bhsd",
 def flash_einsum_path(config) -> bool:
     """Whether the einsum-form flash branch applies: projections write
     the kernel's [B,H,S,Dh] layout directly (layout rides the matmuls).
-    Shared by the llama and gpt2 blocks so gating never diverges."""
+    Shared by the llama and gpt2 blocks so gating never diverges.
+
+    int8 mode KEEPS this path (the projections run as quantized einsums
+    via qeinsum — int8 x int8 -> int32 on the MXU's 2x int8 path);
+    only the emulated fp8 mode falls back to the qdot branch."""
     return (
         config.attn_impl == "flash"
         and not _seq_axis_active()
-        and not fp8_enabled()
+        and quant_mode() != "fp8"
     )
 
 
@@ -438,18 +442,25 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
         # kernel's [B,H,S,Dh] layout and the output projection contracts
         # (h, k) straight back to [B,S,D] — the layout permutation rides
         # the matmuls instead of materialising transpose copies.
-        qt = jnp.einsum("bsd,dhk->bhsk", y,
-                        p["wq"].astype(dtype).reshape(D, h, hd))
-        kt = jnp.einsum("bsd,dhk->bhsk", y,
-                        p["wk"].astype(dtype).reshape(D, kvh, hd))
-        vt = jnp.einsum("bsd,dhk->bhsk", y,
-                        p["wv"].astype(dtype).reshape(D, kvh, hd))
+        # q/k/v as ONE stacked einsum: a single larger MXU contraction,
+        # and one residual copy of y instead of three (the per-call
+        # custom_vjp residuals of the quantized path would otherwise
+        # stack 3x under the layer scan — the difference between
+        # fitting HBM and not in int8 mode)
+        w_qkv = jnp.concatenate(
+            [p["wq"].astype(dtype).reshape(D, h, hd),
+             p["wk"].astype(dtype).reshape(D, kvh, hd),
+             p["wv"].astype(dtype).reshape(D, kvh, hd)], axis=1)
+        qkv = qeinsum("bsd,dhk->bhsk", y, w_qkv)
+        qt = qkv[:, :h]
+        kt = qkv[:, h:h + kvh]
+        vt = qkv[:, h + kvh:]
         # rope_cos/rope_sin are FULL-width here (_maybe_full_rope):
         # rope applies inside the kernels, q/k stay raw
         out = bhsd_flash_attention(
             config, qt, kt, vt, rope_cos=rope_cos, rope_sin=rope_sin)
-        x = x + jnp.einsum("bhsk,hkd->bsd", out,
-                           p["wo"].astype(dtype).reshape(h, hd, D))
+        x = x + qeinsum("bhsk,hkd->bsd", out,
+                        p["wo"].astype(dtype).reshape(h, hd, D))
     else:
         q = qdot(y, p["wq"].astype(dtype)).reshape(B, S, h, hd)
         k = qdot(y, p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
@@ -472,9 +483,24 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
         aux = (config.moe_aux_weight * metrics["aux_loss"]
                + config.moe_z_weight * metrics["z_loss"])
     else:
-        gate = jax.nn.silu(qdot(y, p["w_gate"].astype(dtype)))
-        up = qdot(y, p["w_up"].astype(dtype))
-        mlp = shard_logical(gate * up, ("batch", "seq", "mlp"))
+        if quant_mode() == "fp8":
+            # fp8_dot scales per TENSOR: stacking gate/up would share
+            # one e4m3 scale and crush whichever operand is smaller —
+            # keep independent matmuls there (int8 scales per output
+            # channel, unaffected by the concat)
+            gate = jax.nn.silu(qdot(y, p["w_gate"].astype(dtype)))
+            up = qdot(y, p["w_up"].astype(dtype))
+            mlp = gate * up
+        else:
+            # gate/up as one stacked matmul (same residual-dedup
+            # argument as the qkv stack; one MXU dispatch instead of two)
+            m = p["w_gate"].shape[-1]
+            w_gu = jnp.concatenate(
+                [p["w_gate"].astype(dtype), p["w_up"].astype(dtype)],
+                axis=-1)
+            gu = qdot(y, w_gu)
+            mlp = jax.nn.silu(gu[..., :m]) * gu[..., m:]
+        mlp = shard_logical(mlp, ("batch", "seq", "mlp"))
         x = x + qdot(mlp, p["w_down"].astype(dtype))
         aux = jnp.zeros((), jnp.float32)
     return shard_logical(x, ("batch", "seq", "embed")), aux
@@ -482,25 +508,18 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
 
 def _offload_dots_save_attn_policy():
     """dots -> pinned-host offload, "attn_out" names -> saved in HBM,
-    everything else -> recompute. Hand-composed because
+    everything else -> recompute. Composed with policy_or_names because
     save_from_both_policies only merges boolean policies and the
-    offload variants return Offloadable markers."""
-    offload = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
-        "device", "pinned_host"
+    offload variants return Offloadable markers / a truthy Recompute
+    sentinel."""
+    from dlrover_tpu.parallel.pipeline import policy_or_names
+
+    return policy_or_names(
+        jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        ),
+        jax.checkpoint_policies.save_only_these_names("attn_out"),
     )
-    names = jax.checkpoint_policies.save_only_these_names("attn_out")
-
-    def policy(prim, *args, **kwargs):
-        # the offload policy answers Offloadable (has src/dst) for
-        # unbatched dots and a Recompute SENTINEL (truthy!) otherwise —
-        # only a real offload/save verdict may short-circuit the
-        # attn_out name check
-        verdict = offload(prim, *args, **kwargs)
-        if verdict is True or hasattr(verdict, "dst"):
-            return verdict
-        return names(prim, *args, **kwargs)
-
-    return policy
 
 
 def _stage_fn(config: LlamaConfig):

@@ -47,8 +47,11 @@ def quant_mode() -> str | None:
 def fp8_enabled() -> bool:
     """Whether ANY qdot quantization mode is active (trace-time).
 
-    Name kept for back-compat; gates the same call sites for the int8
-    mode (the einsum-form flash path must yield to qdot either way)."""
+    Name kept for back-compat. NOTE: do NOT use this to gate the
+    einsum-form flash path — int8 mode KEEPS that path (projections run
+    as quantized einsums via :func:`qeinsum`); only fp8 yields to the
+    qdot branch. Gate with ``quant_mode() != "fp8"`` as
+    ``models/llama.py flash_einsum_path`` does."""
     return _Flag.mode is not None
 
 
@@ -156,6 +159,22 @@ def _fp8_dot_bwd(res, g):
 
 
 fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+def qeinsum(spec, a, b):
+    """``jnp.einsum(spec, a, b)``, int8-quantized when
+    ``quant_autocast("int8")`` is active.
+
+    This is the einsum-form projection hook: under int8 the models KEEP
+    the einsum-form flash path (layout rides the quantized matmul, int32
+    MXU accumulation). fp8 mode never reaches these call sites —
+    ``flash_einsum_path`` yields to the qdot branch there (the emulated
+    e4m3 round-trip has no einsum win to preserve)."""
+    if _Flag.mode == "int8":
+        from dlrover_tpu.ops.quantization import int8_einsum
+
+        return int8_einsum(spec, a, b)
+    return jnp.einsum(spec, a, b)
 
 
 def qdot(a, b):

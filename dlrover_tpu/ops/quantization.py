@@ -174,16 +174,19 @@ def dequantize_int8(q, scales, orig_shape, dtype=jnp.float32,
 # capability: amp_optimization.py:197 Fp8Optimization (the CUDA
 # analogue picks fp8 because Hopper has fp8 units).
 #
-# Measured reality (DESIGN.md "Low-precision compute"): the v5e MXU
-# datasheet lists 2x int8 throughput, but XLA:TPU currently lowers
-# int8 dot_general WITHOUT that path (raw int8 dot ~2x slower than
-# bf16 on-chip). auto_accelerate therefore never selects this dtype
-# and warn-gates explicit requests; the path exists for stacks and
-# hardware where the lowering pays.
+# Measured on v5e (DESIGN.md "Low-precision compute"): int8
+# dot_general with int32 accumulation DOES hit the MXU's 2x int8
+# throughput — at the bench model's GEMM shapes the full quantized dot
+# (on-the-fly per-channel quantization included) runs 1.4-2.7x faster
+# than the bf16 dot. The earlier "int8 is slower" conclusion measured
+# a training step that lost the einsum-form flash path (transposes +
+# unfused rope ate the GEMM win); :func:`int8_einsum` keeps that path
+# quantized so the step-level win survives.
 
 
 def _per_channel_q(x, axis):
-    """Symmetric int8 quantization along ``axis`` (the contraction dim).
+    """Symmetric int8 quantization along ``axis`` (the contraction
+    dim(s) — an int or tuple of ints).
 
     Returns (q int8, scale f32 with ``axis`` kept as size 1)."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
@@ -196,6 +199,10 @@ def _per_channel_q(x, axis):
 
 
 def _int8_dot_impl(a, b):
+    """Quantize both operands, dot in int8 -> int32, dequantize.
+
+    Returns (out, (qa, sa, qb, sb)) so the custom_vjp fwd and the
+    primal share ONE body (the primal just drops the residuals)."""
     out_dtype = jnp.promote_types(a.dtype, b.dtype)
     qa, sa = _per_channel_q(a, axis=-1)        # [..., M, 1]
     qb, sb = _per_channel_q(b, axis=0)         # [1, N]
@@ -203,31 +210,180 @@ def _int8_dot_impl(a, b):
         qa, qb, (((qa.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )
-    return (acc.astype(jnp.float32) * sa * sb).astype(out_dtype)
+    out = _name_qdot_out(
+        (acc.astype(jnp.float32) * sa * sb).astype(out_dtype))
+    return out, (qa, sa, qb, sb)
+
+
+def _name_qdot_out(out):
+    """Tag a quantized-matmul result for remat save policies.
+
+    The useful bf16 output is elementwise-scaled from the (never-saved)
+    int32 accumulator, so no dots_* policy would save it; the
+    "qdot_out" name lets parallel/pipeline.py's quant_aware_policy keep
+    it — without which the backward re-runs every projection's
+    quantize+matmul chain under per-layer remat."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(out, "qdot_out")
+
+
+def _name_qdot_res(qa, sa, qb, sb):
+    """Tag the quantized residuals for remat save policies.
+
+    Under per-layer remat, custom_vjp residuals are re-derived in the
+    backward unless the policy saves them — re-running every amax/
+    round/clip quantization chain per layer. The int8 copies are half
+    the bf16 activation bytes, so saving them is exactly the memory
+    deal the quantized residual design was chosen for."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return (checkpoint_name(qa, "qdot_res"), checkpoint_name(sa, "qdot_res"),
+            checkpoint_name(qb, "qdot_res"), checkpoint_name(sb, "qdot_res"))
 
 
 @jax.custom_vjp
 def int8_dot(a, b):
     """``a @ b`` with int8 per-channel forward operands (int32 MXU
-    accumulation) and full-precision bf16 gradients."""
-    return _int8_dot_impl(a, b)
+    accumulation).
+
+    The VJP residuals are the QUANTIZED operands, not the bf16 inputs:
+    half the saved bytes (the difference between fitting HBM and not
+    at a 16-layer scan's stacked residuals), and the backward matmuls
+    run against dequantize(q) — the gradient of the function the
+    forward actually computed (AQT's straight-through convention),
+    rather than of the unquantized matmul."""
+    out, _res = _int8_dot_impl(a, b)
+    return out
 
 
 def _int8_dot_fwd(a, b):
-    return _int8_dot_impl(a, b), (a, b)
+    out, (qa, sa, qb, sb) = _int8_dot_impl(a, b)
+    qa, sa, qb, sb = _name_qdot_res(qa, sa, qb, sb)
+    # dtype carriers: residuals must be jax types, so the operand
+    # dtypes ride along as zero-size arrays
+    return out, (qa, sa, qb, sb, jnp.zeros((0,), a.dtype),
+                 jnp.zeros((0,), b.dtype))
 
 
 def _int8_dot_bwd(res, g):
-    a, b = res
-    da = jnp.matmul(g, b.swapaxes(-1, -2).astype(g.dtype))
-    if a.ndim > 2:
+    qa, sa, qb, sb, a_dt, b_dt = res
+    bd = (qb.astype(g.dtype) * sb.astype(g.dtype))
+    da = jnp.matmul(g, bd.swapaxes(-1, -2))
+    ad = (qa.astype(g.dtype) * sa.astype(g.dtype))
+    if qa.ndim > 2:
         db = jnp.matmul(
-            a.reshape(-1, a.shape[-1]).T.astype(g.dtype),
-            g.reshape(-1, g.shape[-1]),
+            ad.reshape(-1, ad.shape[-1]).T, g.reshape(-1, g.shape[-1])
         )
     else:
-        db = jnp.matmul(a.swapaxes(-1, -2).astype(g.dtype), g)
-    return da.astype(a.dtype), db.astype(b.dtype)
+        db = jnp.matmul(ad.swapaxes(-1, -2), g)
+    return da.astype(a_dt.dtype), db.astype(b_dt.dtype)
 
 
 int8_dot.defvjp(_int8_dot_fwd, _int8_dot_bwd)
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized einsum — the einsum-form projection path
+# ---------------------------------------------------------------------------
+#
+# The models' flash path writes q/k/v in the kernel's [B,H,S,Dh] layout
+# straight out of the projection einsums ("bsd,dhk->bhsk" etc.) so the
+# layout permutation rides the matmul. Quantizing those projections
+# therefore needs a quantized EINSUM, not a quantized 2-D dot — routing
+# them through int8_dot would resurrect the transpose copies the einsum
+# form exists to remove. Per-channel scales are taken over each
+# operand's contracted dims; the scale outer-product is recovered with
+# the same einsum spec applied to the (keepdims) scale tensors.
+
+
+@functools.lru_cache(maxsize=None)
+def _einsum_parts(spec: str, a_ndim: int, b_ndim: int):
+    """Parse a two-operand einsum spec -> (a_sub, b_sub, out_sub,
+    a_contract_dims, b_contract_dims). Validates the spec is explicit
+    and matmul-like (every input dim appears in the output or the other
+    operand, so the transposed backward specs below are well-formed)."""
+    if "->" not in spec or "." in spec:
+        raise ValueError(
+            f"int8_einsum needs an explicit two-operand spec, got {spec!r}")
+    lhs, out_sub = spec.split("->")
+    a_sub, b_sub = lhs.split(",")
+    if len(a_sub) != a_ndim or len(b_sub) != b_ndim:
+        raise ValueError(f"spec {spec!r} does not match operand ranks "
+                         f"({a_ndim}, {b_ndim})")
+    a_c = tuple(i for i, ch in enumerate(a_sub) if ch not in out_sub)
+    b_c = tuple(i for i, ch in enumerate(b_sub) if ch not in out_sub)
+    for sub, other in ((a_sub, b_sub), (b_sub, a_sub)):
+        for ch in sub:
+            if ch not in out_sub and ch not in other:
+                raise ValueError(
+                    f"spec {spec!r}: dim {ch!r} is summed within one "
+                    "operand — not a matmul-like einsum")
+    return a_sub, b_sub, out_sub, a_c, b_c
+
+
+def _scale_to_out(s, sub, out_sub):
+    """Reshape a keepdims per-channel scale (shape of ``sub`` with
+    contracted dims = 1) for broadcasting against the ``out_sub``-shaped
+    einsum output. Pure squeeze/transpose/reshape — an einsum here would
+    be a dot_general over the size-1 contracted axes, which remat
+    policies then dutifully SAVE as a full [out]-shaped f32 buffer per
+    scan iteration (measured: 3 GB of stacked broadcast scale products
+    at the bench model)."""
+    keep = [(ch, d) for ch, d in zip(sub, s.shape) if ch in out_sub]
+    s = s.reshape([d for _ch, d in keep])
+    order = sorted(range(len(keep)), key=lambda i: out_sub.index(keep[i][0]))
+    s = jnp.transpose(s, order)
+    dims = {ch: d for ch, d in keep}
+    return s.reshape([dims.get(ch, 1) for ch in out_sub])
+
+
+def _int8_einsum_impl(spec, a, b):
+    """Quantize, einsum in int8 -> int32, dequantize.
+
+    Returns (out, (qa, sa, qb, sb)); the primal drops the residuals so
+    the custom_vjp fwd and the no-grad path share one body."""
+    out_dtype = jnp.promote_types(a.dtype, b.dtype)
+    a_sub, b_sub, out_sub, a_c, b_c = _einsum_parts(spec, a.ndim, b.ndim)
+    qa, sa = _per_channel_q(a, axis=a_c)
+    qb, sb = _per_channel_q(b, axis=b_c)
+    acc = jnp.einsum(spec, qa, qb, preferred_element_type=jnp.int32)
+    scale = (_scale_to_out(sa, a_sub, out_sub)
+             * _scale_to_out(sb, b_sub, out_sub))
+    out = _name_qdot_out(
+        (acc.astype(jnp.float32) * scale).astype(out_dtype))
+    return out, (qa, sa, qb, sb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def int8_einsum(spec, a, b):
+    """``jnp.einsum(spec, a, b)`` with int8 per-channel forward operands
+    (int32 MXU accumulation) and straight-through gradients.
+
+    Like :func:`int8_dot`, the residuals are the quantized operands:
+    half the stacked-residual bytes under a layer scan, and the
+    backward einsums see dequantize(q) — the gradient of the quantized
+    forward (AQT convention)."""
+    out, _res = _int8_einsum_impl(spec, a, b)
+    return out
+
+
+def _int8_einsum_fwd(spec, a, b):
+    out, (qa, sa, qb, sb) = _int8_einsum_impl(spec, a, b)
+    qa, sa, qb, sb = _name_qdot_res(qa, sa, qb, sb)
+    return out, (qa, sa, qb, sb, jnp.zeros((0,), a.dtype),
+                 jnp.zeros((0,), b.dtype))
+
+
+def _int8_einsum_bwd(spec, res, g):
+    qa, sa, qb, sb, a_dt, b_dt = res
+    a_sub, b_sub, out_sub, _a_c, _b_c = _einsum_parts(
+        spec, qa.ndim, qb.ndim)
+    ad = qa.astype(g.dtype) * sa.astype(g.dtype)
+    bd = qb.astype(g.dtype) * sb.astype(g.dtype)
+    da = jnp.einsum(f"{out_sub},{b_sub}->{a_sub}", g, bd)
+    db = jnp.einsum(f"{a_sub},{out_sub}->{b_sub}", ad, g)
+    return da.astype(a_dt.dtype), db.astype(b_dt.dtype)
+
+
+int8_einsum.defvjp(_int8_einsum_fwd, _int8_einsum_bwd)

@@ -107,7 +107,13 @@ def _remat_wrap(loss_fn, policy_name: str):
         )
     else:  # "full"
         policy = jax.checkpoint_policies.nothing_saveable
-    return jax.checkpoint(loss_fn, policy=policy)
+    # same int8 adaptation the per-layer scan applies: without it, a
+    # model with config.remat=False under strategy remat would save the
+    # stacked int32 qa@qb accumulators (HBM OOM) and recompute every
+    # quantization chain in the backward. No-op for unquantized models.
+    from dlrover_tpu.parallel.pipeline import quant_aware_policy
+
+    return jax.checkpoint(loss_fn, policy=quant_aware_policy(policy))
 
 
 def auto_accelerate(
@@ -221,7 +227,7 @@ def auto_accelerate(
     # TPU-native mode (2x MXU throughput on v5e); "fp8" is EMULATED on
     # TPUs without fp8 units and measured ~20% slower than bf16 there.
     quant = compute_dtype if compute_dtype in ("fp8", "int8") else None
-    if quant is not None:
+    if quant == "fp8":
         import jax as _jax
 
         kinds = {
@@ -229,17 +235,20 @@ def auto_accelerate(
             for d in (devices if devices is not None else _jax.devices())
         }
         if not any("v6" in k or "v7" in k for k in kinds):
-            # measured on v5e (DESIGN.md "Low-precision compute"): the
-            # emulated fp8 step is ~+20% and int8 ~+30% vs bf16 — XLA
-            # lowers int8 dots without MXU acceleration on this
-            # hardware. The engine's candidate generator never proposes
-            # these dtypes; an explicit request is honored but loud.
+            # fp8 is EMULATED (e4m3 round-trip) on TPUs without fp8
+            # units — measured ~+20-28% step time vs bf16 on v5e. int8
+            # does NOT warn: int8 x int8 -> int32 dots hit the MXU's 2x
+            # int8 path (DESIGN.md "Low-precision compute") and the
+            # einsum-form projections stay quantized via qeinsum, so
+            # the int8 step is measured FASTER than bf16 on this
+            # hardware. int8 remains opt-in (quantization changes
+            # numerics); the engine's candidate generator proposes
+            # neither dtype.
             logger.warning(
-                "compute_dtype=%r on %s: no accelerated low-precision "
-                "matmul path on this hardware/stack — measured SLOWER "
-                "than bf16 (fp8 ~+20%%, int8 ~+30%% step time). "
-                "Keep bfloat16 unless you are on fp8/int8-MXU hardware.",
-                quant, sorted(kinds) or "unknown devices",
+                "compute_dtype='fp8' on %s: no fp8 units — the e4m3 "
+                "emulation is measured SLOWER than bf16 (~+20%% step "
+                "time). Use 'int8' (2x MXU path) or keep bfloat16.",
+                sorted(kinds) or "unknown devices",
             )
     cast_dtype = "bfloat16" if quant else compute_dtype
     inner_loss = _remat_wrap(loss_fn, strategy.remat)

@@ -1114,6 +1114,58 @@ def _interleaved_tables(S: int, V: int, M: int):
     return tables, T, R
 
 
+def policy_or_names(policy, names):
+    """OR a remat save policy with a ``save_only_these_names`` policy,
+    respecting offload policies' non-boolean verdicts: an Offloadable
+    marker (has ``.dst``) must win, and the truthy Recompute sentinel
+    must NOT read as a save — ``save_from_both_policies`` can merge
+    neither, which is why this is hand-rolled (single home for the
+    sentinel contract; models compose their own name policies with it
+    too, e.g. llama's offload+attn_out variant)."""
+    def p(prim, *args, **kwargs):
+        verdict = policy(prim, *args, **kwargs)
+        if verdict is True or hasattr(verdict, "dst"):
+            return verdict
+        return names(prim, *args, **kwargs)
+
+    return p
+
+
+def quant_aware_policy(policy):
+    """Adapt a remat save policy to the int8 quantized-matmul path.
+
+    Two adjustments, both no-ops for unquantized models:
+
+    1. NEVER save integer dot_generals: the qa @ qb accumulators are
+       int32 [*, out]-shaped — the dots_* policies would save them
+       stacked per scan layer (measured: 5.5 GB for the gate/up
+       accumulator alone at the bench model, the difference between
+       fitting HBM and OOM). The backward never consumes the
+       accumulator (the custom_vjp residuals are the small int8
+       operands), so nothing is recomputed from excluding it.
+    2. ALWAYS save tensors named "qdot_out" (the bf16 result of a
+       quantized matmul, tagged in ops/quantization.py): the useful
+       output is elementwise-scaled from the excluded accumulator, so
+       no dots_* policy would save it — without the name the backward
+       re-runs every projection's quantize+matmul chain, which costs
+       the int8 path its step-time win. Saving it restores exactly the
+       bytes the bf16 path's saved dot outputs occupy."""
+    merged = policy_or_names(
+        policy,
+        jax.checkpoint_policies.save_only_these_names(
+            "qdot_out", "qdot_res"),
+    )
+
+    def p(prim, *args, **params):
+        if getattr(prim, "name", "") == "dot_general":
+            pe = params.get("preferred_element_type")
+            if pe is not None and jnp.issubdtype(pe, jnp.integer):
+                return False
+        return merged(prim, *args, **params)
+
+    return p
+
+
 def stage_layer_scan(
     layer_fn: Callable,
     remat: bool = True,
@@ -1123,7 +1175,9 @@ def stage_layer_scan(
     local stacked layers (the in-stage analogue of the model's full-depth
     ``lax.scan``), accumulating per-layer aux losses.
 
-    ``layer_fn(h, one_layer_params, *extras) -> (h, aux)``.
+    ``layer_fn(h, one_layer_params, *extras) -> (h, aux)``. Whatever
+    save policy applies (passed or default) is adapted to the int8
+    quantized path via :func:`quant_aware_policy`.
     """
 
     def body(carry, layer_params, *extras):
@@ -1138,8 +1192,11 @@ def stage_layer_scan(
         if remat:
             scan_body = jax.checkpoint(
                 scan_body,
-                policy=policy
-                or jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                policy=quant_aware_policy(
+                    policy
+                    or jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable
+                ),
             )
         (h, aux_sum), _ = jax.lax.scan(
             scan_body, (h, jnp.zeros((), jnp.float32)), local_params

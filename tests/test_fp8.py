@@ -242,3 +242,105 @@ class TestInt8Dot:
         l16 = helper._run("bfloat16")
         assert l8[-1] < l8[0] * 0.9, f"int8 loss did not drop: {l8}"
         assert abs(l8[-1] - l16[-1]) / l16[-1] < 0.05, (l8[-1], l16[-1])
+
+
+class TestInt8Einsum:
+    """int8 quantized einsum — the einsum-form projection path
+    (quantization.py int8_einsum; routed by fp8.py qeinsum)."""
+
+    def test_matches_quantized_ground_truth(self):
+        from dlrover_tpu.ops.quantization import _per_channel_q, int8_einsum
+
+        rng = np.random.RandomState(0)
+        a = jnp.asarray(rng.randn(2, 16, 32), jnp.float32)
+        b = jnp.asarray(rng.randn(32, 4, 8), jnp.float32)
+        out = np.asarray(int8_einsum("bsd,dhk->bhsk", a, b), np.float64)
+        qa, sa = _per_channel_q(a, axis=(2,))
+        qb, sb = _per_channel_q(b, axis=(0,))
+        # float64 ground truth: the int32-accumulated kernel is MORE
+        # exact than an f32 einsum of the dequantized operands
+        truth = np.einsum(
+            "bsd,dhk->bhsk",
+            np.asarray(qa, np.float64) * np.asarray(sa, np.float64),
+            np.asarray(qb, np.float64) * np.asarray(sb, np.float64),
+        )
+        assert np.max(np.abs(out - truth)) < 1e-5
+
+    def test_close_to_exact_and_grads(self):
+        from dlrover_tpu.ops.quantization import int8_einsum
+
+        rng = np.random.RandomState(1)
+        a = jnp.asarray(rng.randn(2, 16, 32), jnp.float32)
+        b = jnp.asarray(rng.randn(32, 4, 8), jnp.float32)
+        out = np.asarray(int8_einsum("bsd,dhk->bhsk", a, b), np.float64)
+        exact = np.einsum("bsd,dhk->bhsk", np.asarray(a, np.float64),
+                          np.asarray(b, np.float64))
+        rel = np.max(np.abs(out - exact)) / np.max(np.abs(exact))
+        assert rel < 0.1, rel
+        # AQT straight-through grads: einsum grads of the DEQUANTIZED
+        # operands — close to the unquantized grads at quantization
+        # error scale
+        g_q = jax.grad(
+            lambda a, b: jnp.sum(int8_einsum("bsd,dhk->bhsk", a, b)),
+            (0, 1))(a, b)
+        g_e = jax.grad(
+            lambda a, b: jnp.sum(jnp.einsum("bsd,dhk->bhsk", a, b)),
+            (0, 1))(a, b)
+        for gq, ge in zip(g_q, g_e):
+            rel = float(jnp.max(jnp.abs(gq - ge))) / (
+                float(jnp.max(jnp.abs(ge))) + 1e-6)
+            assert rel < 0.05, rel
+
+    def test_wo_and_gpt2_specs(self):
+        from dlrover_tpu.ops.quantization import int8_einsum
+
+        rng = np.random.RandomState(2)
+        o2 = int8_einsum(
+            "bhsk,hkd->bsd",
+            jnp.asarray(rng.randn(2, 4, 16, 8), jnp.float32),
+            jnp.asarray(rng.randn(4, 8, 32), jnp.float32))
+        assert o2.shape == (2, 16, 32)
+        o3 = int8_einsum(
+            "bsd,dthk->tbhsk",
+            jnp.asarray(rng.randn(2, 16, 32), jnp.float32),
+            jnp.asarray(rng.randn(32, 3, 4, 8), jnp.float32))
+        assert o3.shape == (3, 2, 4, 16, 8)
+
+    def test_rejects_non_matmul_specs(self):
+        from dlrover_tpu.ops.quantization import int8_einsum
+
+        a = jnp.zeros((2, 16, 32))
+        b = jnp.zeros((32, 4, 8))
+        for bad in ("bsd,dhk->bhs",      # b's h/k dims half-dropped
+                    "bsd,shk->bhk",      # s summed within one operand
+                    "bsd,dhk"):          # implicit output
+            with pytest.raises(ValueError):
+                int8_einsum(bad, a, b)
+
+    def test_qeinsum_routes_by_mode(self):
+        from dlrover_tpu.ops.fp8 import qeinsum, quant_autocast
+
+        rng = np.random.RandomState(3)
+        a = jnp.asarray(rng.randn(2, 8, 32), jnp.bfloat16)
+        b = jnp.asarray(rng.randn(32, 2, 16), jnp.bfloat16)
+        plain = qeinsum("bsd,dhk->bhsk", a, b)
+        with quant_autocast("int8"):
+            q = qeinsum("bsd,dhk->bhsk", a, b)
+        assert q.shape == plain.shape
+        assert not np.allclose(np.asarray(plain, np.float32),
+                               np.asarray(q, np.float32), atol=0)
+
+    def test_flash_einsum_path_stays_active_under_int8(self):
+        from dlrover_tpu.models.llama import flash_einsum_path
+        from dlrover_tpu.ops.fp8 import quant_autocast
+
+        cfg = LlamaConfig(
+            vocab_size=64, dim=64, n_layers=1, n_heads=2, n_kv_heads=2,
+            mlp_dim=64, attn_impl="flash")
+        assert flash_einsum_path(cfg)
+        with quant_autocast("int8"):
+            assert flash_einsum_path(cfg), \
+                "int8 must keep the einsum-form flash path"
+        with quant_autocast("fp8"):
+            assert not flash_einsum_path(cfg), \
+                "emulated fp8 must yield to the qdot branch"
