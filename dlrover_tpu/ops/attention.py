@@ -45,6 +45,7 @@ unit-test suite exercises the real kernel code paths on the CPU mesh.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -64,14 +65,44 @@ NEG_INF = -1e30
 STATS_W = 128
 
 
+class _MaskCtx:
+    """Trace-time extras for the causal mask family (sliding window,
+    prefix-LM). Set by the public entries via :func:`_mask_extras` and
+    read by every mask helper, so the packed-grid machinery and all
+    seven kernels pick them up without threading two more parameters
+    through each signature. The custom_vjp boundary re-establishes the
+    context in ``_anchor_bwd`` (the backward is traced outside the
+    entry's dynamic extent).
+
+    Reference parity: Mistral-style sliding windows and GLM-style
+    prefix-LM masks, which the reference reaches through its CUDA
+    flash-attn wrappers (atorch/atorch/modules/transformer/layers.py:
+    1168 flash_attn_with_mask_bias, :1256 fa2_with_glm_mask)."""
+
+    window: int | None = None   # visible iff 0 <= q_pos - k_pos < window
+    prefix: int | None = None   # cols < prefix visible to every row
+
+
+@contextlib.contextmanager
+def _mask_extras(window, prefix):
+    prev = (_MaskCtx.window, _MaskCtx.prefix)
+    _MaskCtx.window, _MaskCtx.prefix = window, prefix
+    try:
+        yield
+    finally:
+        _MaskCtx.window, _MaskCtx.prefix = prev
+
+
 def _block_mask(shape, i, j, *, block_q, block_k, causal, q_len, kv_len):
     """Validity mask for a (block_q, block_k) score tile.
 
     Causality is end-aligned (offset = kv_len - q_len), matching
     mha_reference's tril(k_len - q_len); rows/cols beyond the true
     lengths are masked so non-block-multiple shapes stay exact.
+    Visibility under extras: ``(causal & in-window) | in-prefix``.
     ``i``/``j`` may be traced scalars (read from the packed-tile table).
     Returns None when every position is trivially valid."""
+    window, prefix = _MaskCtx.window, _MaskCtx.prefix
     pad_rows = q_len % block_q != 0
     pad_cols = kv_len % block_k != 0
     if not (causal or pad_rows or pad_cols):
@@ -83,12 +114,18 @@ def _block_mask(shape, i, j, *, block_q, block_k, causal, q_len, kv_len):
     def conj(m, new):
         return new if m is None else m & new
 
+    if causal:
+        offset = kv_len - q_len
+        vis = offset + rows >= cols
+        if window is not None:
+            vis &= cols > offset + rows - window
+        if prefix is not None:
+            vis |= cols < prefix
+        mask = conj(mask, vis)
     if pad_rows:
         mask = conj(mask, rows < q_len)
     if pad_cols:
         mask = conj(mask, cols < kv_len)
-    if causal:
-        mask = conj(mask, (kv_len - q_len) + rows >= cols)
     return mask
 
 
@@ -190,18 +227,43 @@ def _wr(ref, val):
 # ---------------------------------------------------------------------------
 
 
-@functools.lru_cache(maxsize=None)
 def _tile_meta(nq, nk, block_q, block_k, q_len, kv_len, causal, kv_major):
+    """int32 [4, T] table of live tiles — see :func:`_tile_meta_impl`.
+
+    Thin reader of the mask-extras context so the lru_cache key always
+    includes the active window/prefix."""
+    return _tile_meta_impl(nq, nk, block_q, block_k, q_len, kv_len,
+                           causal, kv_major, _MaskCtx.window,
+                           _MaskCtx.prefix)
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_meta_impl(nq, nk, block_q, block_k, q_len, kv_len, causal,
+                    kv_major, window, prefix):
     """int32 [4, T] table of live tiles: rows (i, j, first, last).
 
     ``first``/``last`` mark the boundaries of each accumulation group
     (a q-block row for q-major order, a kv-block column for kv-major).
     A group with no live tile keeps one fully-masked placeholder so its
-    output block is still initialised and written."""
+    output block is still initialised and written.
+
+    A sliding window drops tiles entirely below the window band (the
+    long-context payoff: tile count goes from O(S^2) to O(S*window));
+    a prefix keeps tiles above the diagonal whose columns intersect the
+    always-visible prefix region."""
     offset = kv_len - q_len
 
     def live(i, j):
-        return (not causal) or (j * block_k < offset + (i + 1) * block_q)
+        if not causal:
+            return True
+        c_live = j * block_k < offset + (i + 1) * block_q
+        if window is not None:
+            # dead when every col is older than every row's window edge
+            c_live = c_live and (
+                j * block_k + block_k - 1 > offset + i * block_q - window)
+        if prefix is not None:
+            c_live = c_live or j * block_k < prefix
+        return c_live
 
     rows = []
     if not kv_major:
@@ -235,9 +297,16 @@ def _needs_p_zero(causal, block_q, block_k, q_len, kv_len):
     ``exp(NEG_INF - finite) == 0`` exactly — the select is a wasted VPU
     pass per masked tile. Padded tiles (or q-longer-than-kv) contain
     fully-masked rows whose stats are +/-inf or NaN, where 0*NaN would
-    otherwise leak into the contractions."""
+    otherwise leak into the contractions.
+
+    A sliding window re-introduces the hazard: a window-edge tile is
+    live for its in-window rows while its out-of-window rows see NO
+    valid column in that tile — and it can be those rows' FIRST visited
+    tile (earlier tiles are window-dead), where m_prev == m_new ==
+    NEG_INF makes exp(s - m_new) == 1 garbage."""
     return (q_len % block_q != 0 or kv_len % block_k != 0
-            or (causal and kv_len < q_len))
+            or (causal and kv_len < q_len)
+            or (causal and _MaskCtx.window is not None))
 
 
 def _needs_mask_static(causal, block_q, block_k, q_len, kv_len):
@@ -247,12 +316,23 @@ def _needs_mask_static(causal, block_q, block_k, q_len, kv_len):
 
 def _mask_needed(i, j, *, causal, block_q, block_k, q_len, kv_len):
     """Dynamic predicate: this tile contains masked positions — it
-    crosses the causal diagonal or is a padded edge block. Interior
-    tiles skip all mask VPU work."""
+    crosses the causal diagonal, the window's lower edge, the prefix
+    boundary, or is a padded edge block. Interior tiles skip all mask
+    VPU work."""
+    window, prefix = _MaskCtx.window, _MaskCtx.prefix
     need = jnp.bool_(False)
     if causal:
         offset = kv_len - q_len
         need = need | (j * block_k + (block_k - 1) > offset + i * block_q)
+        if window is not None:
+            # some col is at or below some row's window edge
+            need = need | (
+                j * block_k <= offset + (i + 1) * block_q - 1 - window)
+        if prefix is not None:
+            # tiles wholly above the diagonal live only via the prefix;
+            # they carry masked positions when they cross its edge
+            above = j * block_k > offset + (i + 1) * block_q - 1
+            need = need | (above & (j * block_k + block_k > prefix))
     if q_len % block_q != 0:
         need = need | (i == pl.cdiv(q_len, block_q) - 1)
     if kv_len % block_k != 0:
@@ -1555,27 +1635,31 @@ def ring_dkv_block(q, k, v, do, lse, delta, q_start, k_start, sm_scale,
 # happen at the primal level.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(7, 17)))
+@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(7, 19)))
 def _anchor(q, k, v, rope_cos, rope_sin, o, lse, layout, heads, kv_heads,
             sm_scale, causal, block_q, block_k, bwd_block_q, bwd_block_k,
-            interpret):
+            interpret, window, prefix):
     return o
 
 
 def _anchor_fwd(q, k, v, rope_cos, rope_sin, o, lse, layout, heads,
                 kv_heads, sm_scale, causal, block_q, block_k, bwd_block_q,
-                bwd_block_k, interpret):
+                bwd_block_k, interpret, window, prefix):
     return o, (q, k, v, o, lse, rope_cos, rope_sin)
 
 
 def _anchor_bwd(layout, heads, kv_heads, sm_scale, causal, block_q, block_k,
-                bwd_block_q, bwd_block_k, interpret, res, do):
+                bwd_block_q, bwd_block_k, interpret, window, prefix, res,
+                do):
     q, k, v, o, lse, rope_cos, rope_sin = res
-    dq, dk, dv = _bwd(
-        layout, heads, kv_heads, sm_scale, causal, bwd_block_q, bwd_block_k,
-        interpret, (q, k, v, o, lse), do,
-        rope_cos=rope_cos, rope_sin=rope_sin,
-    )
+    # the backward is traced outside the public entry's dynamic extent —
+    # re-establish the mask extras around the kernel construction
+    with _mask_extras(window, prefix):
+        dq, dk, dv = _bwd(
+            layout, heads, kv_heads, sm_scale, causal, bwd_block_q,
+            bwd_block_k, interpret, (q, k, v, o, lse), do,
+            rope_cos=rope_cos, rope_sin=rope_sin,
+        )
     zc = None if rope_cos is None else jnp.zeros_like(rope_cos)
     zs = None if rope_sin is None else jnp.zeros_like(rope_sin)
     return dq, dk, dv, zc, zs, jnp.zeros_like(o), jnp.zeros_like(lse)
@@ -1586,7 +1670,7 @@ _anchor.defvjp(_anchor_fwd, _anchor_bwd)
 
 def _flash(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
            block_k, bwd_block_q, bwd_block_k, interpret,
-           rope_cos=None, rope_sin=None):
+           rope_cos=None, rope_sin=None, window=None, prefix=None):
     from jax.ad_checkpoint import checkpoint_name
 
     # stop_gradient on the *inputs* keeps AD tracing out of the pallas
@@ -1595,17 +1679,29 @@ def _flash(q, k, v, layout, heads, kv_heads, sm_scale, causal, block_q,
     if rope_cos is not None:
         rope_cos = jax.lax.stop_gradient(rope_cos)
         rope_sin = jax.lax.stop_gradient(rope_sin)
-    o, lse = _fwd(
-        jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
-        jax.lax.stop_gradient(v), layout, heads, kv_heads, sm_scale, causal,
-        block_q, block_k, interpret,
-        rope_cos=rope_cos, rope_sin=rope_sin,
-    )
+    with _mask_extras(window, prefix):
+        o, lse = _fwd(
+            jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
+            jax.lax.stop_gradient(v), layout, heads, kv_heads, sm_scale,
+            causal, block_q, block_k, interpret,
+            rope_cos=rope_cos, rope_sin=rope_sin,
+        )
     o = checkpoint_name(o, "attn_out")
     lse = checkpoint_name(lse, "attn_out")
     return _anchor(q, k, v, rope_cos, rope_sin, o, lse, layout, heads,
                    kv_heads, sm_scale, causal, block_q, block_k,
-                   bwd_block_q, bwd_block_k, interpret)
+                   bwd_block_q, bwd_block_k, interpret, window, prefix)
+
+
+def _check_mask_extras(causal, window, prefix_len):
+    if window is None and prefix_len is None:
+        return
+    if not causal:
+        raise ValueError("window/prefix_len require causal=True")
+    if window is not None and int(window) < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    if prefix_len is not None and int(prefix_len) < 0:
+        raise ValueError(f"prefix_len must be >= 0, got {prefix_len}")
 
 
 def flash_attention(
@@ -1619,6 +1715,8 @@ def flash_attention(
     interpret: bool | None = None,
     rope_cos=None,
     rope_sin=None,
+    window: int | None = None,
+    prefix_len: int | None = None,
 ):
     """Multi-head attention, O(S) memory, MXU-tiled ([B,H,S,Dh] layout).
 
@@ -1635,6 +1733,13 @@ def flash_attention(
         which removes the XLA-side rope read-modify-write passes
         entirely (they run at sub-peak bandwidth as pad/concat
         relayouts). Self-attention only (q_len == kv_len).
+      window: Mistral-style sliding window — position i attends to
+        [i-window+1, i] (global positions, end-aligned). The packed
+        grid drops out-of-window tiles, so cost scales O(S*window).
+      prefix_len: GLM-style prefix-LM — the first ``prefix_len`` kv
+        positions are visible to EVERY query row (bidirectional prefix,
+        causal beyond). Both require causal=True and compose
+        (visibility = (causal & in-window) | in-prefix).
     Returns [batch, heads, q_len, head_dim] in q.dtype.
     """
     if sm_scale is None:
@@ -1642,6 +1747,7 @@ def flash_attention(
     if q.shape[1] % k.shape[1] != 0:
         raise ValueError(
             f"q heads {q.shape[1]} not divisible by kv {k.shape[1]}")
+    _check_mask_extras(causal, window, prefix_len)
     if rope_cos is not None:
         if q.shape[2] != k.shape[2]:
             raise ValueError(
@@ -1657,7 +1763,9 @@ def flash_attention(
                   float(sm_scale), bool(causal),
                   int(block_q), int(block_k),
                   int(bwd_block_q or block_q), int(bwd_block_k or block_k),
-                  bool(interpret), rope_cos=rope_cos, rope_sin=rope_sin)
+                  bool(interpret), rope_cos=rope_cos, rope_sin=rope_sin,
+                  window=None if window is None else int(window),
+                  prefix=None if prefix_len is None else int(prefix_len))
 
 
 def flash_attention_bshd(
