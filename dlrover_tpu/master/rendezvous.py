@@ -191,6 +191,9 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._node_status: dict[int, dict[int, bool]] = {}
         # round -> {node_rank: elapsed}
         self._node_times_by_round: dict[int, dict[int, float]] = {}
+        # round -> frozen grouping (stable for the round even as late
+        # previous-round reports trickle in)
+        self._groups_by_round: dict[int, list[list[int]]] = {}
         self._check_round = 0
         self._fault_nodes: set[int] = set()
         self._stragglers: set[int] = set()
@@ -216,23 +219,86 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             return self._rdzv_round, 0, {}, ""
 
     def _group_nodes(self, check_round: int) -> list[list[int]]:
-        """Pair nodes 2-by-2; alternate rounds rotate the pairing so a
-        node never keeps the same partner, which lets two failing rounds
-        pinpoint the bad node (reference _group_nodes :364)."""
+        """Pair nodes 2-by-2 (reference _group_nodes :364-409).
+
+        First round: sequential pairs. Later rounds: sort nodes by the
+        previous round's result — normal nodes first, then by measured
+        elapsed time — and pair fastest-with-slowest, never re-pairing
+        a node with its previous-round partner. Every strongly abnormal
+        node (faulty: slow or failed hard) gets a known-good fast
+        partner, while mildly abnormal nodes (victims of a faulty
+        partner) pair with each other and pass, so two faulty nodes out
+        of six are both pinned in two rounds (reference
+        `_check_abnormal_nodes` regrouping + time-sorted round 1).
+
+        The grouping is computed once per round and cached: the fault
+        verdict intersects *consecutive* rounds, so a repeated pair
+        would condemn the faulty node's healthy partner with it, and a
+        late previous-round report must not re-shuffle a round already
+        handed to some nodes.
+        """
+        cached = self._groups_by_round.get(check_round)
+        if cached is not None:
+            return cached
         ranks = sorted(self._rdzv_nodes.keys())
         n = len(ranks)
         if n <= 2:
-            return [ranks]
-        if check_round % 2 == 1:
+            groups = [list(ranks)]
+            self._groups_by_round[check_round] = groups
+            return groups
+        prev_times = self._node_times_by_round.get(check_round - 1, {})
+        if not prev_times:
             pairs = [ranks[i : i + 2] for i in range(0, n - (n % 2), 2)]
             if n % 2:
                 pairs[-1].append(ranks[-1])
-        else:
-            # rotate: last node pairs with first
-            rotated = [ranks[-1]] + ranks[:-1]
-            pairs = [rotated[i : i + 2] for i in range(0, n - (n % 2), 2)]
-            if n % 2:
-                pairs[-1].append(rotated[-1])
+            self._groups_by_round[check_round] = pairs
+            return pairs
+        prev_status = self._node_status.get(check_round - 1, {})
+        prev_partners: dict[int, set[int]] = {}
+        for group in self._groups_by_round.get(check_round - 1, []):
+            for r in group:
+                prev_partners[r] = {g for g in group if g != r}
+
+        def sort_key(r):
+            # abnormal nodes last, slowest-most-suspect at the very end
+            failed = 0 if prev_status.get(r, False) else 1
+            return (failed, prev_times.get(r, float("inf")), r)
+
+        order = sorted(ranks, key=sort_key)
+        pairs = []
+        while len(order) >= 2:
+            a = order.pop(0)  # fastest remaining
+            # slowest remaining that was not a's previous partner
+            pick = len(order) - 1
+            for k in range(len(order) - 1, -1, -1):
+                if order[k] not in prev_partners.get(a, ()):
+                    pick = k
+                    break
+            pairs.append(sorted([a, order.pop(pick)]))
+        if order:
+            pairs[-1].append(order.pop())
+            pairs[-1].sort()
+
+        # the greedy can corner itself: the last two remaining nodes may
+        # be previous partners. With disjoint previous pairs a single
+        # cross-swap with any other pair resolves without creating a new
+        # repeat; verify both halves anyway (triples make partners
+        # non-unique).
+        def conflicted(p):
+            return len(p) == 2 and p[1] in prev_partners.get(p[0], set())
+
+        for i, p in enumerate(pairs):
+            if not conflicted(p):
+                continue
+            for j, q in enumerate(pairs):
+                if j == i or len(q) != 2:
+                    continue
+                cand_p = sorted([p[0], q[1]])
+                cand_q = sorted([q[0], p[1]])
+                if not conflicted(cand_p) and not conflicted(cand_q):
+                    pairs[i], pairs[j] = cand_p, cand_q
+                    break
+        self._groups_by_round[check_round] = pairs
         return pairs
 
     def report_network_check_result(

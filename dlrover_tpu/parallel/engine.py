@@ -171,6 +171,25 @@ def _factorizations(n: int, dims: int):
             yield (d,) + rest
 
 
+def _dcn_placement(pipe: int, data: int, fsdp: int, n_slices: int):
+    """Distribute ``n_slices`` across the DCN-tolerant axes, cheapest
+    traffic first: pipe (p2p stage activations) > data (one grad
+    allreduce/step) > fsdp (adds per-step param all-gather over DCN).
+    Returns (dcn_pipe, dcn_data, dcn_fsdp) or None if the factorization
+    cannot absorb all slices."""
+    import math as _math
+
+    remaining = n_slices
+    placement = []
+    for size in (pipe, data, fsdp):
+        f = _math.gcd(size, remaining)
+        placement.append(f)
+        remaining //= f
+    if remaining != 1:
+        return None
+    return tuple(placement)
+
+
 def candidate_strategies(
     n_devices: int,
     analysis: ModelAnalysis,
@@ -181,6 +200,9 @@ def candidate_strategies(
     hidden: int | None = None,
     max_candidates: int = 16,
     allow_pipe: bool = True,
+    n_slices: int = 1,
+    ici_gbps: float = 180.0,
+    dcn_gbps: float = 25.0,
 ) -> list[Strategy]:
     """Enumerate feasible mesh factorizations, best-first.
 
@@ -189,8 +211,19 @@ def candidate_strategies(
     - then tensor ≤ devices_per_host (TP collectives stay on-host ICI),
     - pipe only when allowed and layers are stacked,
     - discard meshes whose HBM estimate exceeds capacity.
+
+    Multi-slice (``n_slices > 1``, the reference's cross-node scale —
+    atorch distributed.py:321 nested node-level groups): every candidate
+    must place the slice boundary on DCN-tolerant axes (pipe/data/fsdp;
+    tensor/seq/expert collectives are per-layer and must stay on ICI).
+    The cost model charges DCN traffic by the ICI:DCN bandwidth
+    asymmetry (``ici_gbps/dcn_gbps``, default v5e-ish 180:25): pipeline
+    stages pay least (p2p activations), data next (one gradient
+    allreduce per step), fsdp most (adds the param all-gather to every
+    step).
     """
     hbm = hbm_gb * (1 << 30)
+    bw_ratio = max(ici_gbps / max(dcn_gbps, 1e-9), 1.0)
     seen: set = set()
     out: list[tuple[float, Strategy]] = []
     for data, fsdp, tensor, pipe in _factorizations(n_devices, 4):
@@ -204,8 +237,24 @@ def candidate_strategies(
         if key in seen:
             continue
         seen.add(key)
+        dcn_pipe = dcn_data = dcn_fsdp = 1
+        dcn_cost = 0.0
+        if n_slices > 1:
+            placed = _dcn_placement(pipe, data, fsdp, n_slices)
+            if placed is None:
+                continue  # slice boundary would cut an ICI-only axis
+            dcn_pipe, dcn_data, dcn_fsdp = placed
+            import math as _math
+
+            dcn_cost = (
+                0.01 * _math.log2(dcn_pipe)
+                + 0.06 * _math.log2(dcn_data)
+                + 0.15 * _math.log2(dcn_fsdp)
+            ) * (bw_ratio / 7.0)
         mesh = MeshConfig(
-            pipe=pipe, data=data, fsdp=fsdp, expert=1, seq=1, tensor=tensor
+            pipe=pipe, data=data, fsdp=fsdp, expert=1, seq=1,
+            tensor=tensor, dcn_pipe=dcn_pipe, dcn_data=dcn_data,
+            dcn_fsdp=dcn_fsdp,
         )
         # cheapest-compute first: the first memory-feasible remat level
         # wins ('none' is fastest when it fits)
@@ -226,6 +275,7 @@ def candidate_strategies(
                 + {"none": 0.0, "minimal": 0.05, "offload": 0.10,
                    "full": 0.15}[remat]
                 + 0.10 * (data > 1 and fsdp == 1)  # pure DP replicates
+                + dcn_cost
             )
             out.append((score, s))
             break  # cheapest feasible remat for this mesh only
@@ -244,11 +294,13 @@ def candidate_strategies(
                 if m.fsdp % cand == 0:
                     seq = cand
                     break
-            if seq > 1:
+            if seq > 1 and (m.fsdp // seq) % m.dcn_fsdp == 0:
                 extra.append(Strategy(
                     mesh=MeshConfig(
                         pipe=m.pipe, data=m.data, fsdp=m.fsdp // seq,
                         expert=1, seq=seq, tensor=m.tensor,
+                        dcn_pipe=m.dcn_pipe, dcn_data=m.dcn_data,
+                        dcn_fsdp=m.dcn_fsdp,
                     ),
                     remat=s.remat,
                 ))
@@ -264,11 +316,13 @@ def candidate_strategies(
                 if m.fsdp % cand == 0:
                     exp = cand
                     break
-            if exp > 1:
+            if exp > 1 and (m.fsdp // exp) % m.dcn_fsdp == 0:
                 extra.append(Strategy(
                     mesh=MeshConfig(
                         pipe=m.pipe, data=m.data, fsdp=m.fsdp // exp,
                         expert=exp, seq=m.seq, tensor=m.tensor,
+                        dcn_pipe=m.dcn_pipe, dcn_data=m.dcn_data,
+                        dcn_fsdp=m.dcn_fsdp,
                     ),
                     remat=s.remat,
                 ))
@@ -396,8 +450,9 @@ def _ranks(values: list) -> list[float]:
 
 
 def _strategy_features(s: Strategy):
-    """Embed a candidate in R^7 for the GP kernel: log2 mesh dims +
-    remat ordinal (scaled so one mesh-halving ~ one remat level)."""
+    """Embed a candidate in R^8 for the GP kernel: log2 mesh dims +
+    remat ordinal (scaled so one mesh-halving ~ one remat level) +
+    DCN exposure."""
     import math
 
     m = s.mesh
@@ -412,6 +467,10 @@ def _strategy_features(s: Strategy):
         math.log2(max(m.seq, 1)),
         math.log2(max(m.expert, 1)),
         remat_ord,
+        # DCN exposure: slices crossed by bandwidth-hungry axes dominate
+        # the comm profile, so they get their own GP dimension
+        math.log2(max(m.dcn_data * m.dcn_fsdp, 1))
+        + 0.5 * math.log2(max(m.dcn_pipe, 1)),
     ]
 
 

@@ -147,6 +147,98 @@ class TestNetworkCheck:
         faults, reason = mgr.check_fault_node()
         assert faults == [3]
 
+    def _drive_round(self, mgr, n, faulty):
+        """Form a round, read back the probe groups, and report what a
+        real agent fleet would: a group containing a faulty node fails
+        for every member (the collective breaks), with the faulty node
+        itself much slower (its own probe hangs to timeout) than its
+        victim partners."""
+        self._form(mgr, n)
+        groups = {}
+        for r in range(n):
+            _, _, world, _ = mgr.get_comm_world(r)
+            groups[r] = set(world.keys())
+        for r in range(n):
+            bad_group = groups[r] & faulty
+            if bad_group:
+                elapsed = 30.0 if r in faulty else 5.0
+                mgr.report_network_check_result(r, False, elapsed)
+            else:
+                mgr.report_network_check_result(r, True, 1.0)
+        return groups
+
+    def test_two_faulty_of_six_pinned_in_two_rounds(self):
+        """Reference-parity pairing (rdzv_manager.py:364-420): round 2
+        sorts by round-1 measured elapsed and pairs fastest-with-
+        slowest, so each faulty node lands with a known-good partner
+        and both are isolated after exactly two rounds."""
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(6, 6, 60, 1)
+        faulty = {1, 3}
+        self._drive_round(mgr, 6, faulty)
+        ok, _ = mgr.network_check_success()
+        assert not ok
+        faults, _ = mgr.check_fault_node()
+        assert faults == []  # one failed round cannot yet pinpoint
+        groups = self._drive_round(mgr, 6, faulty)
+        # each faulty node got a fresh (previously-normal) partner, and
+        # the round-1 victims were paired together
+        assert groups[1] != {0, 1} and groups[3] != {2, 3}
+        assert groups[0] == {0, 2}
+        faults, reason = mgr.check_fault_node()
+        assert faults == [1, 3]
+
+    def test_time_sorted_pairing_puts_suspects_with_normals(self):
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(6, 6, 60, 1)
+        self._drive_round(mgr, 6, {1, 3})
+        self._form(mgr, 6)
+        groups = mgr._group_nodes(mgr._check_round)
+        assert sorted(map(sorted, groups)) == [[0, 2], [1, 5], [3, 4]]
+
+    def test_no_pair_repeats_across_consecutive_rounds(self):
+        """An intermittent fault must not condemn a healthy partner:
+        the verdict intersects consecutive rounds, so no pair may
+        repeat between round k and k+1 once timing data exists."""
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(6, 6, 60, 1)
+        prev_pairs: set = set()
+        faulty = {4}
+        for rnd in range(4):
+            self._form(mgr, 6)
+            groups = mgr._group_nodes(mgr._check_round)
+            pairs = {frozenset(g) for g in groups}
+            if rnd > 0:
+                assert not (pairs & prev_pairs), (
+                    f"round {rnd + 1} repeats pairs {pairs & prev_pairs}"
+                )
+            prev_pairs = pairs
+            for g in groups:
+                bad = set(g) & faulty
+                for r in g:
+                    if bad:
+                        mgr.report_network_check_result(
+                            r, False, 30.0 if r in faulty else 5.0
+                        )
+                    else:
+                        mgr.report_network_check_result(r, True, 1.0)
+        # across all rounds only the truly faulty node gets pinned
+        faults, _ = mgr.check_fault_node()
+        assert faults == [4]
+
+    def test_grouping_stable_within_round(self):
+        """Late previous-round reports must not reshuffle a grouping
+        some nodes already received."""
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(4, 4, 60, 1)
+        self._drive_round(mgr, 4, {1})
+        self._form(mgr, 4)
+        first = mgr._group_nodes(mgr._check_round)
+        # a straggling duplicate report rewrites the previous round's
+        # timing after some nodes already got their groups
+        mgr._node_times_by_round[mgr._check_round - 1][0] = 99.0
+        assert mgr._group_nodes(mgr._check_round) == first
+
     def test_straggler_detection(self):
         mgr = NetworkCheckRendezvousManager()
         mgr.update_rdzv_params(4, 4, 60, 1)
