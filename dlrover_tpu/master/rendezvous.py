@@ -276,28 +276,48 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                     break
             pairs.append(sorted([a, order.pop(pick)]))
         if order:
-            pairs[-1].append(order.pop())
-            pairs[-1].sort()
+            # odd count: the leftover probes alone (the reference's
+            # middle node, rdzv_manager.py:395-409 while-loop tail).
+            # Appending it to a pair instead would make consecutive
+            # no-repeat groupings impossible by pigeonhole once a
+            # previous round held a triple.
+            pairs.append([order.pop()])
 
-        # the greedy can corner itself: the last two remaining nodes may
-        # be previous partners. With disjoint previous pairs a single
-        # cross-swap with any other pair resolves without creating a new
-        # repeat; verify both halves anyway (triples make partners
-        # non-unique).
-        def conflicted(p):
-            return len(p) == 2 and p[1] in prev_partners.get(p[0], set())
+        # the greedy can corner itself: the last nodes placed together
+        # may be previous partners. Repair by swapping one member with
+        # a member of another group, accepting the first swap that
+        # leaves both groups repeat-free.
+        import itertools
 
-        for i, p in enumerate(pairs):
-            if not conflicted(p):
+        def conflicted(g):
+            return any(
+                b in prev_partners.get(a, set())
+                for a, b in itertools.combinations(g, 2)
+            )
+
+        for i, g in enumerate(pairs):
+            if not conflicted(g):
                 continue
+            done = False
             for j, q in enumerate(pairs):
-                if j == i or len(q) != 2:
+                if done or j == i:
                     continue
-                cand_p = sorted([p[0], q[1]])
-                cand_q = sorted([q[0], p[1]])
-                if not conflicted(cand_p) and not conflicted(cand_q):
-                    pairs[i], pairs[j] = cand_p, cand_q
-                    break
+                for xi in range(len(g)):
+                    for yi in range(len(q)):
+                        cand_g = sorted(
+                            g[:xi] + [q[yi]] + g[xi + 1:]
+                        )
+                        cand_q = sorted(
+                            q[:yi] + [g[xi]] + q[yi + 1:]
+                        )
+                        if not conflicted(cand_g) and not conflicted(
+                            cand_q
+                        ):
+                            pairs[i], pairs[j] = cand_g, cand_q
+                            done = True
+                            break
+                    if done:
+                        break
         self._groups_by_round[check_round] = pairs
         return pairs
 
@@ -361,13 +381,42 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             prev_abnormal = {
                 r for r, ok in prev.items() if not ok
             }
-            self._fault_nodes = abnormal & prev_abnormal
+            fault = abnormal & prev_abnormal
+            fault -= self._victims(fault, (rnd - 1, rnd))
+            self._fault_nodes = fault
             if not self._fault_nodes:
                 return [], NetworkFailureReason.WAITING_NODE
             return (
                 sorted(self._fault_nodes),
                 NetworkFailureReason.NODE_FAILURE,
             )
+
+    def _victims(self, fault: set, rounds) -> set:
+        """Nodes whose every failing round is explained by a strictly
+        slower co-member of the same probe group that is itself in the
+        fault set: collateral damage of a faulty partner (an unlucky
+        node can draw a different faulty partner twice in a row when
+        faulty nodes outnumber known-good ones), not faults. The faulty
+        node's own probe runs to timeout, so it is the slow one."""
+
+        def explained(x, rnd):
+            times = self._node_times_by_round.get(rnd, {})
+            tx = times.get(x)
+            if tx is None:
+                return False
+            for group in self._groups_by_round.get(rnd, []):
+                if x in group:
+                    return any(
+                        y != x and y in fault
+                        and times.get(y, 0.0) > tx
+                        for y in group
+                    )
+            return False
+
+        return {
+            x for x in fault
+            if all(explained(x, rnd) for rnd in rounds)
+        }
 
     def get_stragglers(self) -> tuple[list[int], bool]:
         """Straggler = elapsed > 2x median of the round (reference

@@ -34,6 +34,12 @@ from dlrover_tpu.parallel.accelerate import (  # noqa: F401
     AccelerateResult,
     auto_accelerate,
 )
+from dlrover_tpu.parallel.adapter import (  # noqa: F401
+    StackedModule,
+    accelerate_module,
+    infer_logical_axes,
+    stack_layer_params,
+)
 from dlrover_tpu.parallel.pipeline import (  # noqa: F401
     pipe_size,
     pipeline_apply,

@@ -116,6 +116,71 @@ def _remat_wrap(loss_fn, policy_name: str):
     return jax.checkpoint(loss_fn, policy=quant_aware_policy(policy))
 
 
+def param_shardings_for(param_logical_axes, mesh, rules=None):
+    """NamedShardings for a params pytree from its logical axis names."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from dlrover_tpu.parallel.sharding import DEFAULT_RULES
+
+    rules = rules if rules is not None else DEFAULT_RULES
+    param_specs = jax.tree.map(
+        lambda axes: logical_to_mesh_axes(axes, rules),
+        param_logical_axes,
+        is_leaf=lambda x: isinstance(x, tuple) or x is None,
+    )
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda s: isinstance(s, PartitionSpec),
+    )
+
+
+def compute_state_shardings(
+    init_fn, optimizer, param_logical_axes, mesh, rules=None, seed: int = 0
+):
+    """(param_shardings, opt_shardings) for a model + optax optimizer.
+
+    Optimizer-state subtrees that mirror the params pytree (optax
+    mu/nu/trace/...) take the param shardings element-wise; everything
+    else (counts, schedules) replicates. Structural matching avoids
+    collisions between same-shaped params with different layouts.
+    Pass ``optimizer=None`` for frozen models (opt_shardings is None).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    param_shardings = param_shardings_for(param_logical_axes, mesh, rules)
+    if optimizer is None:
+        return param_shardings, None
+    abstract_params = jax.eval_shape(init_fn, jax.random.key(seed))
+    abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
+    params_struct = jax.tree.structure(abstract_params)
+    abstract_param_leaves = jax.tree.leaves(abstract_params)
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def _is_param_tree(sub):
+        try:
+            if jax.tree.structure(sub) != params_struct:
+                return False
+        except Exception:  # noqa: BLE001 - exotic nodes: not a match
+            return False
+        leaves = jax.tree.leaves(sub)
+        return all(
+            getattr(l, "shape", None) == p.shape
+            and getattr(l, "dtype", None) == p.dtype
+            for l, p in zip(leaves, abstract_param_leaves)
+        )
+
+    opt_shardings = jax.tree.map(
+        lambda sub: param_shardings if _is_param_tree(sub) else (
+            jax.tree.map(lambda _: replicated, sub)
+        ),
+        abstract_opt,
+        is_leaf=_is_param_tree,
+    )
+    return param_shardings, opt_shardings
+
+
 def auto_accelerate(
     loss_fn: Callable,  # (params, batch, rng) -> scalar loss (or (loss, aux))
     init_fn: Callable,  # (rng) -> params
@@ -159,49 +224,10 @@ def auto_accelerate(
         if not any(name == "layer" for name, _ in rules):
             rules = rules + (("layer", "pipe"),)
 
-    def spec_of(axes):
-        return logical_to_mesh_axes(axes, rules)
-
-    param_specs = jax.tree.map(
-        spec_of,
-        param_logical_axes,
-        is_leaf=lambda x: isinstance(x, tuple) or x is None,
+    param_shardings, opt_shardings = compute_state_shardings(
+        init_fn, optimizer, param_logical_axes, mesh, rules, seed=seed
     )
-    param_shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), param_specs,
-        is_leaf=lambda s: isinstance(s, PartitionSpec),
-    )
-
-    # Optimizer state shardings: subtrees that mirror the params pytree
-    # (optax mu/nu/trace/...) take the param shardings element-wise;
-    # everything else (counts, schedules) replicates. Structural matching
-    # avoids collisions between same-shaped params with different layouts.
-    abstract_params = jax.eval_shape(init_fn, jax.random.key(seed))
-    abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
-    params_struct = jax.tree.structure(abstract_params)
-    abstract_param_leaves = jax.tree.leaves(abstract_params)
     replicated = NamedSharding(mesh, PartitionSpec())
-
-    def _is_param_tree(sub):
-        try:
-            if jax.tree.structure(sub) != params_struct:
-                return False
-            leaves = jax.tree.leaves(sub)
-        except Exception:  # noqa: BLE001 - exotic nodes: not a match
-            return False
-        return all(
-            getattr(l, "shape", None) == p.shape
-            and getattr(l, "dtype", None) == p.dtype
-            for l, p in zip(leaves, abstract_param_leaves)
-        )
-
-    opt_shardings = jax.tree.map(
-        lambda sub: param_shardings if _is_param_tree(sub) else (
-            jax.tree.map(lambda _: replicated, sub)
-        ),
-        abstract_opt,
-        is_leaf=_is_param_tree,
-    )
     state_shardings = TrainState(
         step=replicated, params=param_shardings, opt_state=opt_shardings
     )

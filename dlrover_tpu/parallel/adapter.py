@@ -1,0 +1,369 @@
+"""Generic-model ingestion for ``auto_accelerate``.
+
+Equivalent capability: the reference accelerates *arbitrary* user
+models — ``ModelContext`` wraps any nn.Module
+(atorch/atorch/auto/model_context.py), graph partition produces pipeline
+stages automatically
+(atorch/auto/opt_lib/pipeline_parallel_optimization.py:56), and a
+1.3k-LoC registry rewrites HF modules into TP forms
+(modules/distributed_modules/modules_registry.py).
+
+TPU redesign: no tracing, no module rewriting. A third-party
+layer-stacked model is described by three functions over its params tree
+(:class:`StackedModule`); everything else is derived:
+
+- **logical axes** come from :func:`infer_logical_axes`, which
+  pattern-matches parameter names (q/k/v/out/gate/up/down, HF and
+  Megatron spellings) and shapes (column vs row orientation against the
+  inferred hidden width, vocab-sized dims) — the automatic analogue of
+  hand-writing ``llama_logical_axes`` or a TPInfo declaration
+  (``manual_tp.py``).
+- **pipeline stages** come from the stacked ``layers`` axis: the staged
+  forward built by :func:`stacked_loss_fn` runs the GPipe schedule
+  (``parallel/pipeline.py``) whenever the ``pipe`` mesh axis is active —
+  the graph-partition analogue, with the partition boundary defined by
+  the layer stack instead of an FX trace.
+- models that keep layers as *numbered sibling subtrees* (flax linen
+  ``layers_0``/``layers_1``, HF ``h.0``/``h.1``) are re-stacked into one
+  scanned axis by :func:`stack_layer_params`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "StackedModule",
+    "infer_logical_axes",
+    "stack_layer_params",
+    "stacked_loss_fn",
+    "accelerate_module",
+]
+
+
+# --------------------------------------------------------------------------
+# logical-axis inference
+# --------------------------------------------------------------------------
+
+# name fragments marking the two Megatron orientations (HF, Megatron,
+# flax and torch spellings). Column-parallel = output dim sharded;
+# row-parallel = input dim sharded (reference modules_registry.py maps
+# module classes the same way; here names are enough because the
+# *orientation* is all that matters for a sharding annotation).
+_COL_PAT = re.compile(
+    r"(^|[._/])(wq|wk|wv|w1|w_gate|w_up|fc1|fc_in|gate|up"
+    r"|q_proj|k_proj|v_proj|query|key|value|in_proj"
+    r"|query_key_value|h_to_4h|wi(_\d)?)([._/]|$)"
+)
+_ROW_PAT = re.compile(
+    r"(^|[._/])(wo|w2|w_down|fc2|fc_out|down|o_proj|out_proj"
+    r"|dense(_4h_to_h)?|proj_out|wo_\d|wo\d|attn_out|w_o)([._/]|$)"
+)
+_VOCAB_PAT = re.compile(
+    r"(^|[._/])(embed\w*|wte|word_embeddings|lm_head|vocab\w*"
+    r"|embedding)([._/]|$)"
+)
+_LAYER_PAT = re.compile(r"(^|[._/])(layers?|blocks?|h)([._/]|$)")
+
+
+def _infer_hidden(leaves) -> int:
+    """Modal residual width: the smaller trailing dim of most weight
+    matrices (same structural vote as engine.analyse_params)."""
+    import collections
+
+    votes: collections.Counter = collections.Counter()
+    for _, shape in leaves:
+        if len(shape) >= 2:
+            votes[int(min(shape[-2], shape[-1]))] += 1
+    return votes.most_common(1)[0][0] if votes else 0
+
+
+def _axes_for_leaf(name: str, shape, hidden: int, vocab: int,
+                   stacked: bool):
+    """Logical axes tuple for one parameter."""
+    ndim = len(shape)
+    axes: list = [None] * ndim
+    lead = 0
+    if stacked and ndim >= 2:
+        axes[0] = "layer"
+        lead = 1
+    body = shape[lead:]
+    bdim = len(body)
+    low = name.lower()
+
+    def setb(i, val):
+        axes[lead + i] = val
+
+    # vocab-bearing params: the vocab-sized dim shards over "vocab",
+    # hidden-sized dims over "embed"
+    if vocab and any(d == vocab for d in body) and (
+        _VOCAB_PAT.search(low) or vocab > 4 * max(hidden, 1)
+    ):
+        for i, d in enumerate(body):
+            if d == vocab:
+                setb(i, "vocab")
+            elif d == hidden:
+                setb(i, "embed")
+        return tuple(axes)
+    if bdim == 1:
+        # norms / hidden-sized biases shard over embed (fsdp); output
+        # biases of column layers follow the tensor axis
+        setb(0, "embed" if body[0] == hidden else "mlp")
+        return tuple(axes)
+    if bdim == 2:
+        r, c = body
+        if _ROW_PAT.search(low):
+            setb(0, "mlp")
+            setb(1, "embed")
+        elif _COL_PAT.search(low):
+            setb(0, "embed")
+            setb(1, "mlp")
+        elif r == hidden and c > hidden:
+            setb(0, "embed")
+            setb(1, "mlp")  # column orientation by shape
+        elif r > hidden and c == hidden:
+            setb(0, "mlp")
+            setb(1, "embed")  # row orientation by shape
+        else:
+            # square / unknown: column default (safe — GSPMD inserts
+            # the all-gather where the consumer needs it)
+            setb(0, "embed")
+            setb(1, "mlp")
+        return tuple(axes)
+    # >=3D body (fused heads [D, H, hd], expert stacks [E, D, M], ...):
+    # hidden dims -> embed, the largest remaining dim -> mlp
+    rest = [i for i, d in enumerate(body) if d != hidden]
+    for i, d in enumerate(body):
+        if d == hidden:
+            setb(i, "embed" if "embed" not in axes else None)
+    if rest:
+        big = max(rest, key=lambda i: body[i])
+        setb(big, "mlp")
+    return tuple(axes)
+
+
+def infer_logical_axes(params, vocab_size: Optional[int] = None,
+                       hidden: Optional[int] = None):
+    """Derive a logical-axes pytree for an arbitrary params tree.
+
+    ``params`` may be real arrays or an ``eval_shape`` tree. Parameters
+    under a stacked layers subtree (path matching layers/blocks/h with a
+    leading stack dim) keep a leading ``layer`` axis so the pipe axis
+    can shard them. ``vocab_size`` enables vocab-parallel embeds/heads;
+    without it they fall back to embed-only sharding (never a silent
+    mis-shard).
+    """
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    named = []
+    for path, leaf in flat:
+        name = ".".join(
+            str(getattr(e, "key", getattr(e, "idx", e))) for e in path
+        )
+        named.append((name, tuple(getattr(leaf, "shape", ()))))
+    h = hidden or _infer_hidden(named)
+    # a subtree is "stacked" when its path names a layer container and
+    # its leading dim is shared by every >=2D leaf under that container
+    lead_dims = [
+        shape[0] for name, shape in named
+        if _LAYER_PAT.search(name.lower()) and len(shape) >= 2
+    ]
+    stack_n = None
+    if lead_dims and len(set(lead_dims)) == 1:
+        stack_n = lead_dims[0]
+    axes_leaves = []
+    for name, shape in named:
+        stacked = (
+            stack_n is not None
+            and _LAYER_PAT.search(name.lower()) is not None
+            and len(shape) >= 2
+            and shape[0] == stack_n
+        )
+        axes_leaves.append(
+            _axes_for_leaf(name, shape, h, vocab_size or 0, stacked)
+        )
+    return jax.tree_util.tree_unflatten(treedef, axes_leaves)
+
+
+# --------------------------------------------------------------------------
+# numbered-sibling restacking (flax linen layers_0/layers_1, HF h.0/h.1)
+# --------------------------------------------------------------------------
+
+
+def stack_layer_params(params, into: str = "layers"):
+    """Re-stack numbered sibling subtrees into one scanned axis.
+
+    ``{"layer_0": T, "layer_1": T, ...}`` (or ``{"0": T, "1": T}``
+    under a container key) becomes ``{into: stacked-T}`` where every
+    leaf gains a leading ``[L]`` dim. Returns ``(stacked_params,
+    unstack_fn)``; ``unstack_fn`` restores the original structure (for
+    checkpoint export back to the source model).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(params, dict):
+        raise TypeError("stack_layer_params expects a dict params tree")
+    num_re = re.compile(r"^(.*?)[._]?(\d+)$")
+    groups: dict[str, list] = {}
+    for key in params:
+        m = num_re.match(str(key))
+        if m:
+            groups.setdefault(m.group(1), []).append(
+                (int(m.group(2)), key)
+            )
+    # The layer stack is the largest numbered family with a shared tree
+    # structure whose members are CONTAINERS (a transformer block is a
+    # subtree of weights). Numbered raw-array families (w1/w2/w3
+    # projection weights) share a trivial structure too and may
+    # outnumber the real blocks — stacking those as "layers" would run
+    # the pipeline schedule over projection matrices, so they only
+    # qualify when their name says layer-ish.
+    layerish = re.compile(r"(layer|block|h|stage|encoder|decoder)s?$")
+    best_prefix, best = None, []
+    for prefix, members in groups.items():
+        if len(members) < 2:
+            continue
+        structs = {
+            jax.tree.structure(params[k]) for _, k in members
+        }
+        if len(structs) != 1:
+            continue
+        is_container = all(
+            isinstance(params[k], (dict, list, tuple))
+            for _, k in members
+        )
+        if not is_container and not layerish.search(
+            prefix.strip("._").lower()
+        ):
+            continue
+        if len(members) > len(best):
+            best_prefix, best = prefix, sorted(members)
+    if not best:
+        raise ValueError(
+            "no numbered layer family found to stack "
+            f"(keys: {sorted(map(str, params))[:8]}...)"
+        )
+    keys = [k for _, k in best]
+    stacked = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves, axis=0),
+        *[params[k] for k in keys],
+    )
+    rest = {k: v for k, v in params.items() if k not in keys}
+    rest[into] = stacked
+    n = len(keys)
+
+    def unstack(tree):
+        out = {k: v for k, v in tree.items() if k != into}
+        layer_stack = tree[into]
+        for i, k in enumerate(keys):
+            out[k] = jax.tree.map(lambda a: a[i], layer_stack)
+        return out
+
+    logger.info(
+        "stacked %d '%s*' subtrees into '%s' [%d, ...]",
+        n, best_prefix, into, n,
+    )
+    return rest, unstack
+
+
+# --------------------------------------------------------------------------
+# staged forward + one-call acceleration
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StackedModule:
+    """Minimal description of a layer-stacked third-party model.
+
+    The params tree from ``init_fn`` must hold the stacked layers under
+    ``params["layers"]`` (use :func:`stack_layer_params` to get there
+    from numbered-sibling layouts).
+    """
+
+    init_fn: Callable       # rng -> params (with stacked "layers")
+    embed_fn: Callable      # (params, batch) -> h [B, ...]
+    layer_fn: Callable      # (h, layer_params) -> h | (h, aux)
+    head_loss_fn: Callable  # (params, h, batch, rng) -> scalar loss
+    n_microbatches: int = 0  # pipe schedule M (0 = 2 x stages)
+    remat_layers: bool = False
+
+
+def _normalized_layer(layer_fn):
+    import jax.numpy as jnp
+
+    def fn(h, lp):
+        out = layer_fn(h, lp)
+        if isinstance(out, tuple):
+            h2, aux = out
+            return h2, jnp.asarray(aux, jnp.float32)
+        return out, jnp.zeros((), jnp.float32)
+
+    return fn
+
+
+def stacked_loss_fn(spec: StackedModule) -> Callable:
+    """(params, batch, rng) -> loss, running the layer stack through
+    the GPipe schedule whenever the ``pipe`` mesh axis is active (the
+    automatic pipeline-stage derivation: partition boundary = the
+    stacked layer axis, reference
+    pipeline_parallel_optimization.py:56)."""
+
+    def loss_fn(params, batch, rng):
+        from dlrover_tpu.parallel.pipeline import (
+            pipe_size,
+            pipeline_apply,
+            stage_layer_scan,
+        )
+
+        stage_fn = stage_layer_scan(
+            _normalized_layer(spec.layer_fn), remat=spec.remat_layers
+        )
+        h = spec.embed_fn(params, batch)
+        if pipe_size() > 1:
+            h, aux = pipeline_apply(
+                stage_fn, params["layers"], h,
+                n_microbatches=spec.n_microbatches,
+            )
+        else:
+            h, aux = stage_fn(params["layers"], h)
+        return spec.head_loss_fn(params, h, batch, rng) + aux
+
+    return loss_fn
+
+
+def accelerate_module(
+    spec: StackedModule,
+    optimizer,
+    strategy=None,
+    vocab_size: Optional[int] = None,
+    seed: int = 0,
+    **kwargs,
+):
+    """One call from a third-party layer-stacked model to a sharded
+    train step: derives logical axes automatically and feeds
+    ``auto_accelerate`` — no hand-written axes, no model rewrites
+    (reference auto_accelerate over a ModelContext,
+    auto/accelerate.py:406)."""
+    import jax
+
+    from dlrover_tpu.parallel.accelerate import auto_accelerate
+
+    abstract = jax.eval_shape(spec.init_fn, jax.random.key(seed))
+    axes = infer_logical_axes(abstract, vocab_size=vocab_size)
+    return auto_accelerate(
+        stacked_loss_fn(spec),
+        spec.init_fn,
+        optimizer,
+        axes,
+        strategy=strategy,
+        seed=seed,
+        **kwargs,
+    )

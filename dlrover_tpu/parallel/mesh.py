@@ -202,23 +202,33 @@ def _hybrid_device_array(devices, sizes: dict, dcn: dict):
         )
     have_slice_idx = all(
         getattr(d, "slice_index", None) is not None for d in devices
-    )
+    ) and len({d.slice_index for d in devices}) > 1
     if have_slice_idx:
-        try:
-            return mesh_utils.create_hybrid_device_mesh(
-                ici_shape, dcn_shape, devices=devices,
-                allow_split_physical_axes=True,
-            )
-        except Exception:  # noqa: BLE001 - fall through to manual layout
-            pass
+        # real multi-slice hardware: a config/hardware mismatch must be
+        # an error, not a silent contiguous-chunk layout that would
+        # route ICI-only axes across DCN
+        return mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices,
+            allow_split_physical_axes=True,
+        )
     groups = _slice_groups(devices)
     per_slice = len(devices) // n_slices
-    if len(groups) != n_slices or any(
-        len(g) != per_slice for g in groups
-    ):
+    if len(groups) > 1:
+        # real slice/process structure (multi-host): it must match the
+        # configured DCN factors exactly
+        if len(groups) != n_slices or any(
+            len(g) != per_slice for g in groups
+        ):
+            raise ValueError(
+                f"config wants {n_slices} DCN slices of {per_slice} "
+                f"devices, but the platform has "
+                f"{[len(g) for g in groups]} devices per slice/process"
+                " — fix the dcn_* factors to match the hardware"
+            )
+    else:
         # single-process virtual platform: contiguous chunks are the
         # slices (deterministic, good enough for compile validation)
-        flat = [d for g in groups for d in g]
+        flat = groups[0]
         groups = [
             flat[i * per_slice:(i + 1) * per_slice]
             for i in range(n_slices)

@@ -42,9 +42,13 @@ def logical_to_mesh_axes(
     logical_axes: Sequence[Optional[str]],
     rules: Optional[LogicalRules] = None,
 ):
-    """Map a tuple of logical axis names to a PartitionSpec."""
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    ``None`` (no annotation at all) replicates, same as ``()``."""
     from jax.sharding import PartitionSpec
 
+    if logical_axes is None:
+        return PartitionSpec()
     table = _rule_table(rules)
     mesh_axes = []
     used = set()

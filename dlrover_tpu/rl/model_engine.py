@@ -2,18 +2,26 @@
 
 Equivalent capability: reference atorch/atorch/rl/model_engine/
 model_engine.py:35 — builds the four RLHF models, applies a (possibly
-different) acceleration strategy to each, exposes train/eval access.
+different) acceleration strategy to each, exposes train/eval access —
+plus the DS hybrid engine (atorch/atorch/rl/ds_hybrid_engine/) that
+reshapes weights between the training layout and the inference layout.
 
 TPU redesign: each model is (init_fn, loss-agnostic apply_fn, logical
-axes, Strategy); trainable models go through auto_accelerate (sharded
-params + optimizer); frozen models (ref, reward) are just sharded params
-+ a jitted apply. No wrapping/unwrapping — "inference mode" is simply
-calling apply_fn without a gradient.
+axes, Strategy). A spec *with* a Strategy gets its own mesh and GSPMD
+shardings: params (and, for trainable roles, optimizer state) are
+jit-initialised straight into the strategy's layout and the jitted apply
+runs under that mesh. A spec without a Strategy stays single-device
+(plain ``jax.jit``). "Inference mode" is simply calling apply_fn without
+a gradient. The hybrid-engine role is :meth:`reshard`: re-lay a model's
+params onto a *different* mesh/strategy (e.g. train fsdp=4 ->
+KV-cache decode tensor=2) with one measured device_put per leaf — XLA
+moves the shards, no gather-to-host.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Optional
 
 from dlrover_tpu.common.log import get_logger
@@ -37,31 +45,83 @@ class ModelSpec:
 class ModelEngine:
     """Holds the role -> model mapping and their sharded states."""
 
-    def __init__(self, specs: dict, seed: int = 0):
+    def __init__(self, specs: dict, seed: int = 0, devices=None):
         import jax
 
         self.specs = dict(specs)
         self.params: dict = {}
         self.opt_states: dict = {}
+        self.meshes: dict = {}
+        self.param_shardings: dict = {}
         self._apply_jitted: dict = {}
         self._optimizers: dict = {}
         rng = jax.random.key(seed)
         for name, spec in self.specs.items():
             rng, sub = jax.random.split(rng)
-            params = spec.init_fn(sub)
-            self.params[name] = params
-            self._apply_jitted[name] = jax.jit(spec.apply_fn)
+            if spec.trainable and spec.optimizer is None:
+                raise ValueError(
+                    f"trainable model {name!r} needs an optimizer"
+                )
             if spec.trainable:
-                if spec.optimizer is None:
-                    raise ValueError(
-                        f"trainable model {name!r} needs an optimizer"
-                    )
                 self._optimizers[name] = spec.optimizer
-                self.opt_states[name] = spec.optimizer.init(params)
+            if spec.strategy is not None:
+                self._init_sharded(name, spec, sub, devices)
+            else:
+                params = spec.init_fn(sub)
+                self.params[name] = params
+                self._apply_jitted[name] = jax.jit(spec.apply_fn)
+                if spec.trainable:
+                    self.opt_states[name] = spec.optimizer.init(params)
             logger.info(
-                "model engine: %s (%strainable)",
+                "model engine: %s (%strainable, %s)",
                 name, "" if spec.trainable else "not ",
+                spec.strategy.describe() if spec.strategy else "no strategy",
             )
+
+    def _init_sharded(self, name: str, spec: ModelSpec, rng, devices):
+        """Apply the spec's Strategy: own mesh + GSPMD shardings for
+        params (and optimizer state), apply jitted under that mesh
+        (reference model_engine.py applies a per-role atorch strategy)."""
+        import jax
+
+        from dlrover_tpu.parallel.accelerate import compute_state_shardings
+        from dlrover_tpu.parallel.mesh import build_mesh
+
+        strategy = spec.strategy
+        mesh = build_mesh(strategy.mesh, devices=devices)
+        if spec.logical_axes is None:
+            # no axes: replicate params over the mesh (still correct,
+            # but the strategy's sharding dims buy nothing)
+            logger.warning(
+                "model %s has a strategy but no logical_axes; "
+                "params will be replicated", name,
+            )
+            abstract = jax.eval_shape(spec.init_fn, rng)
+            logical_axes = jax.tree.map(lambda _: None, abstract)
+        else:
+            logical_axes = spec.logical_axes
+        param_sh, opt_sh = compute_state_shardings(
+            spec.init_fn,
+            spec.optimizer if spec.trainable else None,
+            logical_axes, mesh, strategy.rules,
+        )
+        self.meshes[name] = mesh
+        self.param_shardings[name] = param_sh
+        with mesh:
+            self.params[name] = jax.jit(
+                spec.init_fn, out_shardings=param_sh
+            )(rng)
+            if spec.trainable:
+                self.opt_states[name] = jax.jit(
+                    spec.optimizer.init, out_shardings=opt_sh
+                )(self.params[name])
+        jitted = jax.jit(spec.apply_fn)
+
+        def run(params, *inputs, _mesh=mesh, _fn=jitted):
+            with _mesh:
+                return _fn(params, *inputs)
+
+        self._apply_jitted[name] = run
 
     # ------------------------------------------------------------- access
 
@@ -89,14 +149,64 @@ class ModelEngine:
         return self.params.get("reward")
 
     def sync_ref_from_actor(self):
-        """Copy actor weights into the frozen reference (periodic KL
-        anchor refresh)."""
+        """Refresh the frozen reference from the actor (periodic KL
+        anchor refresh). When the two roles use different layouts the
+        actor's weights are resharded into the ref's; with identical
+        layouts the immutable actor arrays are shared as-is (jax arrays
+        cannot be mutated in place, so aliasing IS the refresh)."""
         import jax
 
-        if "ref" in self.params and "actor" in self.params:
-            self.params["ref"] = jax.tree.map(
-                lambda x: x, self.params["actor"]
-            )
+        if "ref" not in self.params or "actor" not in self.params:
+            return
+        ref_sh = self.param_shardings.get("ref")
+        actor = self.params["actor"]
+        if ref_sh is not None:
+            self.params["ref"] = jax.device_put(actor, ref_sh)
+        else:
+            self.params["ref"] = actor
+
+    # ------------------------------------------------- hybrid-engine role
+
+    def reshard(
+        self,
+        name: str,
+        target_strategy: Strategy,
+        logical_axes=None,
+        devices=None,
+    ):
+        """Re-lay a model's params onto a different mesh/strategy — the
+        reference DS hybrid engine's train->inference weight reshape
+        (rl/ds_hybrid_engine/). Returns ``(params, mesh, seconds)``;
+        the engine's own copy is untouched (training continues under
+        the original layout).
+
+        XLA moves shards device-to-device (resharding device_put), so
+        e.g. fsdp=4-sharded training weights become tensor=2-sharded
+        decode weights without a host round-trip.
+        """
+        import jax
+
+        from dlrover_tpu.parallel.accelerate import param_shardings_for
+        from dlrover_tpu.parallel.mesh import build_mesh
+
+        spec = self.specs[name]
+        axes = logical_axes if logical_axes is not None else (
+            spec.logical_axes
+        )
+        mesh = build_mesh(target_strategy.mesh, devices=devices)
+        if axes is None:
+            abstract = jax.eval_shape(lambda: self.params[name])
+            axes = jax.tree.map(lambda _: None, abstract)
+        target_sh = param_shardings_for(axes, mesh, target_strategy.rules)
+        t0 = time.perf_counter()
+        resharded = jax.device_put(self.params[name], target_sh)
+        resharded = jax.block_until_ready(resharded)
+        elapsed = time.perf_counter() - t0
+        logger.info(
+            "resharded %s into %s in %.3fs", name,
+            target_strategy.describe(), elapsed,
+        )
+        return resharded, mesh, elapsed
 
     # -------------------------------------------------------- persistence
 

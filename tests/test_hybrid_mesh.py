@@ -213,3 +213,23 @@ class TestDcnAwarePlanner:
         assert cands
         assert all(s.mesh.n_slices == 2 for s in cands)
         assert any(s.mesh.expert > 1 for s in cands)
+
+
+def test_hybrid_mismatch_with_real_process_structure_raises(monkeypatch):
+    """On a platform with real slice/process structure, a dcn config
+    that does not match the hardware must error, not silently chunk."""
+    from dlrover_tpu.parallel import mesh as mesh_mod
+
+    class FakeDev:
+        def __init__(self, i, p):
+            self.id = i
+            self.process_index = p
+
+    # 8 devices over 4 processes, but the config wants 2 slices
+    devs = [FakeDev(i, i // 2) for i in range(8)]
+    with pytest.raises(ValueError, match="fix the dcn_"):
+        mesh_mod._hybrid_device_array(
+            devs,
+            {a: 1 for a in mesh_mod.AXIS_ORDER} | {"data": 2, "fsdp": 4},
+            {"data": 2},
+        )

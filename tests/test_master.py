@@ -330,3 +330,32 @@ class TestHeartbeatAndMetrics:
             assert local_master.servicer.job_success
         finally:
             c.close()
+
+
+def test_odd_count_triple_never_repeats_pairs():
+    """5 nodes, 2 faulty: the odd-count triple must not recreate a
+    previous-round pairing, or a healthy victim is condemned with the
+    faulty node."""
+    from dlrover_tpu.master.rendezvous import NetworkCheckRendezvousManager
+
+    mgr = NetworkCheckRendezvousManager()
+    mgr.update_rdzv_params(5, 5, 60, 1)
+    faulty = {3, 4}
+    for _ in range(3):
+        for r in range(5):
+            mgr.join_rendezvous(r, 1)
+        for r in range(5):
+            mgr.get_comm_world(r)
+        groups = mgr._group_nodes(mgr._check_round)
+        for g in groups:
+            bad = set(g) & faulty
+            for r in g:
+                if bad:
+                    mgr.report_network_check_result(
+                        r, False, 30.0 if r in faulty else 5.0
+                    )
+                else:
+                    mgr.report_network_check_result(r, True, 1.0)
+    faults, _ = mgr.check_fault_node()
+    assert set(faults) <= faulty, f"healthy node condemned: {faults}"
+    assert faults, "faulty nodes never pinned"

@@ -204,3 +204,167 @@ class TestPPOTrainer:
         obs = np.random.RandomState(0).randn(8, 3, 6).astype(np.float32)
         stats = trainer.train([{"obs": obs}], iterations=1)
         assert "policy_loss" in stats
+
+
+class TestModelEngineStrategies:
+    """Per-role acceleration strategies + the hybrid-engine reshard
+    (reference model_engine.py per-model strategies and
+    rl/ds_hybrid_engine train->inference weight reshaping)."""
+
+    def _llama(self):
+        from dlrover_tpu.models.llama import LlamaConfig
+
+        return LlamaConfig(
+            vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            mlp_dim=64, max_seq_len=128, attn_impl="reference",
+            remat=False, dtype="float32",
+        )
+
+    def _spec_axes(self, arr):
+        return set(
+            a for part in tuple(arr.sharding.spec)
+            for a in ((part,) if isinstance(part, str) else (part or ()))
+        )
+
+    def test_train_under_fsdp_then_decode_under_tensor(self):
+        from dlrover_tpu.models import (
+            llama_init,
+            llama_logical_axes,
+            llama_loss_fn,
+        )
+        from dlrover_tpu.models.llama import llama_apply
+        from dlrover_tpu.parallel import MeshConfig, Strategy
+        from dlrover_tpu.rl.generation import (
+            GenerateConfig,
+            KVCacheGenerationBackend,
+        )
+
+        config = self._llama()
+        train_strategy = Strategy(
+            mesh=MeshConfig(data=2, fsdp=4), compute_dtype="float32",
+            remat="none", donate=False,
+        )
+        engine = ModelEngine({
+            "actor": ModelSpec(
+                init_fn=lambda rng: llama_init(config, rng),
+                apply_fn=lambda p, toks: llama_apply(config, p, toks),
+                logical_axes=llama_logical_axes(config),
+                strategy=train_strategy,
+                trainable=True,
+                optimizer=optax.adam(1e-3),
+            ),
+        })
+        wq = engine.params["actor"]["layers"]["wq"]
+        assert "fsdp" in self._spec_axes(wq), wq.sharding
+        # optimizer state inherits the param layout
+        mu_leaves = [
+            l for l in jax.tree.leaves(engine.opt_states["actor"])
+            if getattr(l, "ndim", 0) >= 2
+        ]
+        assert any("fsdp" in self._spec_axes(l) for l in mu_leaves)
+
+        # train one step under the fsdp mesh
+        loss_fn = llama_loss_fn(config)
+        tx = engine.optimizer("actor")
+
+        @jax.jit
+        def update(params, opt_state, tokens, rng):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, {"tokens": tokens}, rng
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 64, (8, 16))
+        )
+        with engine.meshes["actor"]:
+            new_params, new_opt, loss = update(
+                engine.params["actor"], engine.opt_states["actor"],
+                tokens, jax.random.key(0),
+            )
+        assert np.isfinite(float(loss))
+        engine.params["actor"] = new_params
+        engine.opt_states["actor"] = new_opt
+        # training left the layout untouched
+        assert "fsdp" in self._spec_axes(
+            engine.params["actor"]["layers"]["wq"]
+        )
+
+        # hybrid-engine reshard: decode layout uses tensor parallelism
+        gen_strategy = Strategy(mesh=MeshConfig(data=4, tensor=2))
+        gen_params, gen_mesh, secs = engine.reshard("actor", gen_strategy)
+        gq = gen_params["layers"]["wq"]
+        assert "tensor" in self._spec_axes(gq), gq.sharding
+        # the fsdp axis may remain in the spec but is size 1 on the
+        # decode mesh: weights are genuinely tensor-sharded now
+        assert gen_mesh.shape["fsdp"] == 1
+        assert gen_mesh.shape["tensor"] == 2
+        assert secs >= 0
+        # the engine's training copy is untouched
+        assert "fsdp" in self._spec_axes(
+            engine.params["actor"]["layers"]["wq"]
+        )
+
+        # decode with the resharded weights
+        backend = KVCacheGenerationBackend(
+            config, GenerateConfig(max_new_tokens=4, temperature=1.0)
+        )
+        prompts = np.random.RandomState(1).randint(0, 64, (4, 5))
+        with gen_mesh:
+            out = backend.generate(gen_params, prompts, jax.random.key(2))
+        assert out.sequences.shape == (4, 9)
+        assert np.all(np.isfinite(np.asarray(out.logprobs)))
+
+    def test_sync_ref_reshards_into_ref_layout(self):
+        from dlrover_tpu.models import llama_init, llama_logical_axes
+        from dlrover_tpu.models.llama import llama_apply
+        from dlrover_tpu.parallel import MeshConfig, Strategy
+
+        config = self._llama()
+        axes = llama_logical_axes(config)
+        mk = lambda: ModelSpec(
+            init_fn=lambda rng: llama_init(config, rng),
+            apply_fn=lambda p, toks: llama_apply(config, p, toks),
+            logical_axes=axes,
+        )
+        actor = mk()
+        actor.strategy = Strategy(mesh=MeshConfig(data=2, fsdp=4))
+        actor.trainable = True
+        actor.optimizer = optax.sgd(0.1)
+        ref = mk()
+        ref.strategy = Strategy(mesh=MeshConfig(data=4, tensor=2))
+        engine = ModelEngine({"actor": actor, "ref": ref})
+        assert "tensor" in self._spec_axes(
+            engine.params["ref"]["layers"]["wq"]
+        )
+        engine.sync_ref_from_actor()
+        # layout stays the ref's own; values now match the actor
+        rq = engine.params["ref"]["layers"]["wq"]
+        assert "tensor" in self._spec_axes(rq)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(rq)),
+            np.asarray(jax.device_get(engine.params["actor"]["layers"]["wq"])),
+        )
+
+
+def test_strategy_without_axes_replicates():
+    """A spec with a strategy but no logical_axes must replicate (the
+    documented fallback), not crash."""
+    from dlrover_tpu.parallel import MeshConfig, Strategy
+
+    engine = ModelEngine({
+        "reward": ModelSpec(
+            init_fn=lambda rng: {"w": jax.random.normal(rng, (8, 8))},
+            apply_fn=lambda p, x: x @ p["w"],
+            strategy=Strategy(mesh=MeshConfig(fsdp=4)),
+        ),
+    })
+    w = engine.params["reward"]["w"]
+    assert tuple(w.sharding.spec) == ()
+    out = engine.apply("reward", jnp.ones((2, 8)))
+    assert out.shape == (2, 8)
+    p2, mesh, _ = engine.reshard(
+        "reward", Strategy(mesh=MeshConfig(data=4, tensor=2))
+    )
+    assert tuple(p2["w"].sharding.spec) == ()
