@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -65,22 +66,45 @@ NEG_INF = -1e30
 STATS_W = 128
 
 
-class _MaskCtx:
+class _MaskCtxMeta(type):
+    """Class-attribute syntax over thread-local storage: JAX permits
+    concurrent tracing from multiple threads, and a process-global
+    window/prefix would cross-contaminate unrelated kernel builds."""
+
+    @property
+    def window(cls):
+        return getattr(cls._tls, "window", None)
+
+    @window.setter
+    def window(cls, v):
+        cls._tls.window = v
+
+    @property
+    def prefix(cls):
+        return getattr(cls._tls, "prefix", None)
+
+    @prefix.setter
+    def prefix(cls, v):
+        cls._tls.prefix = v
+
+
+class _MaskCtx(metaclass=_MaskCtxMeta):
     """Trace-time extras for the causal mask family (sliding window,
     prefix-LM). Set by the public entries via :func:`_mask_extras` and
     read by every mask helper, so the packed-grid machinery and all
     seven kernels pick them up without threading two more parameters
     through each signature. The custom_vjp boundary re-establishes the
     context in ``_anchor_bwd`` (the backward is traced outside the
-    entry's dynamic extent).
+    entry's dynamic extent). Storage is per-thread (see _MaskCtxMeta).
 
     Reference parity: Mistral-style sliding windows and GLM-style
     prefix-LM masks, which the reference reaches through its CUDA
     flash-attn wrappers (atorch/atorch/modules/transformer/layers.py:
     1168 flash_attn_with_mask_bias, :1256 fa2_with_glm_mask)."""
 
-    window: int | None = None   # visible iff 0 <= q_pos - k_pos < window
-    prefix: int | None = None   # cols < prefix visible to every row
+    _tls = threading.local()
+    # window: visible iff 0 <= q_pos - k_pos < window
+    # prefix: cols < prefix visible to every row
 
 
 @contextlib.contextmanager
@@ -1778,6 +1802,8 @@ def flash_attention_bshd(
     bwd_block_k: int | None = None,
     interpret: bool | None = None,
     fused: bool = True,
+    window: int | None = None,
+    prefix_len: int | None = None,
 ):
     """Flash attention on the model-native [B, S, H, Dh] layout.
 
@@ -1815,6 +1841,7 @@ def flash_attention_bshd(
         fused = False
     if sm_scale is None:
         sm_scale = hd ** -0.5
+    _check_mask_extras(causal, window, prefix_len)
     if interpret is None:
         interpret = _use_interpret()
     if not interpret and hd % 128 != 0:
@@ -1823,6 +1850,7 @@ def flash_attention_bshd(
             v.transpose(0, 2, 1, 3), causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, bwd_block_q=bwd_block_q,
             bwd_block_k=bwd_block_k, interpret=interpret,
+            window=window, prefix_len=prefix_len,
         )
         return o.transpose(0, 2, 1, 3)
     if fused:
@@ -1859,7 +1887,9 @@ def flash_attention_bshd(
         int(H), int(KVH),
         float(sm_scale), bool(causal), int(block_q), int(block_k),
         int(bwd_block_q or block_q), int(bwd_block_k or block_k),
-        bool(interpret))
+        bool(interpret),
+        window=None if window is None else int(window),
+        prefix=None if prefix_len is None else int(prefix_len))
     return o3.reshape(B, S, H, hd)
 
 

@@ -116,6 +116,27 @@ def _remat_wrap(loss_fn, policy_name: str):
     return jax.checkpoint(loss_fn, policy=quant_aware_policy(policy))
 
 
+def rules_for_mesh(rules, mesh):
+    """Adjust a logical-rule table for the active mesh: with a real
+    ``pipe`` axis the stacked ``layer`` dim shards across stages
+    (pipelining is layer-stack sharding under GSPMD). Shared by
+    auto_accelerate and every other sharding consumer (RL ModelEngine)
+    so a per-role Strategy with pipe > 1 cannot silently replicate the
+    layer stack."""
+    if mesh.shape.get("pipe", 1) <= 1:
+        return rules
+    from dlrover_tpu.parallel.sharding import DEFAULT_RULES
+
+    rules = tuple(rules if rules is not None else DEFAULT_RULES)
+    rules = tuple(
+        ("layer", "pipe") if name == "layer" else (name, ax)
+        for name, ax in rules
+    )
+    if not any(name == "layer" for name, _ in rules):
+        rules = rules + (("layer", "pipe"),)
+    return rules
+
+
 def param_shardings_for(param_logical_axes, mesh, rules=None):
     """NamedShardings for a params pytree from its logical axis names."""
     import jax
@@ -214,15 +235,7 @@ def auto_accelerate(
     strategy = strategy or Strategy()
     mesh = build_mesh(strategy.mesh, devices=devices)
     set_mesh(mesh)
-    rules = strategy.rules
-    if mesh.shape.get("pipe", 1) > 1:
-        # pipelining shards the stacked layer axis across stages
-        rules = tuple(
-            ("layer", "pipe") if name == "layer" else (name, ax)
-            for name, ax in rules
-        )
-        if not any(name == "layer" for name, _ in rules):
-            rules = rules + (("layer", "pipe"),)
+    rules = rules_for_mesh(strategy.rules, mesh)
 
     param_shardings, opt_shardings = compute_state_shardings(
         init_fn, optimizer, param_logical_axes, mesh, rules, seed=seed

@@ -226,7 +226,15 @@ def stack_layer_params(params, into: str = "layers"):
     # outnumber the real blocks — stacking those as "layers" would run
     # the pipeline schedule over projection matrices, so they only
     # qualify when their name says layer-ish.
-    layerish = re.compile(r"(layer|block|h|stage|encoder|decoder)s?$")
+    def layerish(prefix: str) -> bool:
+        # exact last path component only: suffix matching would let a
+        # trailing 'h' in branch_*/patch_* qualify raw-array families
+        last = re.split(r"[._/]", prefix.strip("._/").lower())[-1]
+        return last in {
+            "layer", "layers", "block", "blocks", "h",
+            "stage", "stages", "encoder", "decoder",
+        }
+
     best_prefix, best = None, []
     for prefix, members in groups.items():
         if len(members) < 2:
@@ -240,9 +248,7 @@ def stack_layer_params(params, into: str = "layers"):
             isinstance(params[k], (dict, list, tuple))
             for _, k in members
         )
-        if not is_container and not layerish.search(
-            prefix.strip("._").lower()
-        ):
+        if not is_container and not layerish(prefix):
             continue
         if len(members) > len(best):
             best_prefix, best = prefix, sorted(members)
@@ -252,6 +258,12 @@ def stack_layer_params(params, into: str = "layers"):
             f"(keys: {sorted(map(str, params))[:8]}...)"
         )
     keys = [k for _, k in best]
+    if into in params and into not in keys:
+        raise ValueError(
+            f"params already has a {into!r} key outside the stacked "
+            f"family ({best_prefix}*) — it would be silently clobbered;"
+            " pass a different `into` name"
+        )
     stacked = jax.tree.map(
         lambda *leaves: jnp.stack(leaves, axis=0),
         *[params[k] for k in keys],

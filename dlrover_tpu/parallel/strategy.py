@@ -33,7 +33,13 @@ class Strategy:
     compute_dtype: str = "bfloat16"
     # remat policy name: none | minimal | offload | full
     # (jax.checkpoint policies; "offload" round-trips the minimal-level
-    # saves through pinned host memory — HBM relief without recompute)
+    # saves through pinned host memory — HBM relief without recompute).
+    # Under int8/fp8 compute every level is quant-adapted
+    # (pipeline.quant_aware_policy): even "full" still saves the
+    # quantized-matmul outputs, because recomputing a quantization
+    # chain in the backward costs more HBM traffic than the int8 saves
+    # occupy — "full recompute" is a memory contract for *bf16*
+    # tensors, not the int accumulators. No-op for unquantized models.
     remat: str = "minimal"
     # number of microbatches for gradient accumulation (elastic trainer
     # raises this as world size shrinks to keep global batch fixed).

@@ -84,7 +84,10 @@ class ModelEngine:
         (reference model_engine.py applies a per-role atorch strategy)."""
         import jax
 
-        from dlrover_tpu.parallel.accelerate import compute_state_shardings
+        from dlrover_tpu.parallel.accelerate import (
+            compute_state_shardings,
+            rules_for_mesh,
+        )
         from dlrover_tpu.parallel.mesh import build_mesh
 
         strategy = spec.strategy
@@ -103,7 +106,7 @@ class ModelEngine:
         param_sh, opt_sh = compute_state_shardings(
             spec.init_fn,
             spec.optimizer if spec.trainable else None,
-            logical_axes, mesh, strategy.rules,
+            logical_axes, mesh, rules_for_mesh(strategy.rules, mesh),
         )
         self.meshes[name] = mesh
         self.param_shardings[name] = param_sh
@@ -186,7 +189,10 @@ class ModelEngine:
         """
         import jax
 
-        from dlrover_tpu.parallel.accelerate import param_shardings_for
+        from dlrover_tpu.parallel.accelerate import (
+            param_shardings_for,
+            rules_for_mesh,
+        )
         from dlrover_tpu.parallel.mesh import build_mesh
 
         spec = self.specs[name]
@@ -197,7 +203,9 @@ class ModelEngine:
         if axes is None:
             abstract = jax.eval_shape(lambda: self.params[name])
             axes = jax.tree.map(lambda _: None, abstract)
-        target_sh = param_shardings_for(axes, mesh, target_strategy.rules)
+        target_sh = param_shardings_for(
+            axes, mesh, rules_for_mesh(target_strategy.rules, mesh)
+        )
         t0 = time.perf_counter()
         resharded = jax.device_put(self.params[name], target_sh)
         resharded = jax.block_until_ready(resharded)

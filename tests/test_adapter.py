@@ -212,3 +212,25 @@ class TestStackFamilySelection:
         }
         stacked, _ = stack_layer_params(params)
         assert stacked["layers"].shape == (2, 4, 4)
+
+    def test_trailing_h_prefix_not_layerish(self):
+        params = {
+            "branch_0": jnp.zeros((4, 4)), "branch_1": jnp.zeros((4, 4)),
+            "branch_2": jnp.zeros((4, 4)),
+            "block_0": {"k": jnp.zeros((4,))},
+            "block_1": {"k": jnp.zeros((4,))},
+        }
+        stacked, _ = stack_layer_params(params)
+        assert stacked["layers"]["k"].shape == (2, 4)
+        assert "branch_0" in stacked
+
+    def test_into_collision_raises(self):
+        params = {
+            "layers": {"shared": jnp.zeros((4,))},
+            "block_0": {"k": jnp.zeros((4,))},
+            "block_1": {"k": jnp.zeros((4,))},
+        }
+        with pytest.raises(ValueError, match="clobbered"):
+            stack_layer_params(params)
+        stacked, _ = stack_layer_params(params, into="stack")
+        assert "layers" in stacked and "stack" in stacked

@@ -368,3 +368,32 @@ def test_strategy_without_axes_replicates():
         "reward", Strategy(mesh=MeshConfig(data=4, tensor=2))
     )
     assert tuple(p2["w"].sharding.spec) == ()
+
+
+def test_engine_pipe_strategy_shards_layer_stack():
+    """A per-role Strategy with pipe>1 must shard the stacked layer
+    axis (the rules_for_mesh adjustment), not replicate it."""
+    from dlrover_tpu.models import llama_init, llama_logical_axes
+    from dlrover_tpu.models.llama import LlamaConfig, llama_apply
+    from dlrover_tpu.parallel import MeshConfig, Strategy
+
+    config = LlamaConfig(
+        vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=128, attn_impl="reference",
+        remat=False, dtype="float32",
+    )
+    engine = ModelEngine({
+        "actor": ModelSpec(
+            init_fn=lambda rng: llama_init(config, rng),
+            apply_fn=lambda p, t: llama_apply(config, p, t),
+            logical_axes=llama_logical_axes(config),
+            strategy=Strategy(mesh=MeshConfig(pipe=2, data=1, fsdp=4)),
+            trainable=True,
+            optimizer=optax.sgd(0.1),
+        ),
+    })
+    wq = engine.params["actor"]["layers"]["wq"]
+    flat = set()
+    for part in tuple(wq.sharding.spec):
+        flat.update((part,) if isinstance(part, str) else (part or ()))
+    assert "pipe" in flat, wq.sharding
