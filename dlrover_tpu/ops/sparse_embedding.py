@@ -284,6 +284,18 @@ class TieredKvEmbedding(KvEmbedding):
     def host_ids(self) -> int:
         return len(self._host_store)
 
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Next power of two >= n: the demote-gather and promote-scatter
+        run with BUCKETED shapes so jit compiles O(log capacity) kernel
+        variants total instead of one per distinct row count per step
+        (a varying-shape at[].set recompiles every prepare_batch —
+        measured seconds/step of pure compilation)."""
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
     def prepare_batch(self, table, raw_ids):
         """Make every id in ``raw_ids`` device-resident.
 
@@ -317,7 +329,12 @@ class TieredKvEmbedding(KvEmbedding):
             vslots = self.mapper.slots_of(victims)
             order = list(vslots)
             idx = np.asarray([vslots[r] for r in order], np.int32)
-            rows = np.asarray(jnp.take(jnp.asarray(table), idx, axis=0))
+            # bucketed gather: pad with idx[0], drop the tail host-side
+            bidx = np.resize(idx, self._bucket(len(idx)))
+            bidx[len(idx):] = idx[0]
+            rows = np.asarray(
+                jnp.take(jnp.asarray(table), bidx, axis=0)
+            )[: len(idx)]
             for r, row in zip(order, rows):
                 self._host_store[r] = np.array(row)
             self.mapper.evict_ids(order)
@@ -326,7 +343,9 @@ class TieredKvEmbedding(KvEmbedding):
             np.asarray(incoming, np.int64), count=False
         ) if incoming else np.zeros((0,), np.int32)
         if incoming:
-            up_rows = np.empty((len(incoming), self.dim), np.float64)
+            n = len(incoming)
+            b = self._bucket(n)
+            up_rows = np.empty((b, self.dim), np.float64)
             for i, raw in enumerate(incoming):
                 spilled = self._host_store.pop(raw, None)
                 if spilled is None:
@@ -334,9 +353,14 @@ class TieredKvEmbedding(KvEmbedding):
                         self._rng.randn(self.dim) * self.init_scale
                     )
                 up_rows[i] = spilled
-            table = jnp.asarray(table).at[
-                np.asarray(slots_new, np.int32)
-            ].set(jnp.asarray(up_rows, jnp.asarray(table).dtype))
+            # bucketed scatter: padding repeats entry 0 (same slot, same
+            # row — duplicate writes of one value are deterministic)
+            bslots = np.resize(np.asarray(slots_new, np.int32), b)
+            bslots[n:] = bslots[0]
+            up_rows[n:] = up_rows[0]
+            table = jnp.asarray(table).at[bslots].set(
+                jnp.asarray(up_rows, jnp.asarray(table).dtype)
+            )
         # count a use for every id in the batch and map to slots
         slots = self.mapper.lookup(flat)
         return table, slots.reshape(np.shape(raw_ids))

@@ -232,7 +232,8 @@ def stack_layer_params(params, into: str = "layers"):
         last = re.split(r"[._/]", prefix.strip("._/").lower())[-1]
         return last in {
             "layer", "layers", "block", "blocks", "h",
-            "stage", "stages", "encoder", "decoder",
+            "stage", "stages", "encoder", "encoders",
+            "decoder", "decoders",
         }
 
     best_prefix, best = None, []

@@ -343,6 +343,10 @@ class DryRunResult:
     step_s: float = 0.0
     ok: bool = True
     error: str = ""
+    # final measured loss (None when the step returns no "loss" metric):
+    # the quantized-dtype selection gate compares it against the same
+    # mesh's unquantized run before an int8 candidate may win
+    loss: Optional[float] = None
 
 
 class DryRunner:
@@ -373,6 +377,10 @@ class DryRunner:
                 state, metrics = train_step(state, batch, rng)
             jax.block_until_ready(state)
             result.step_s = (time.perf_counter() - t1) / self._iters
+            try:
+                result.loss = float(metrics.get("loss"))
+            except (TypeError, AttributeError):
+                pass
         except Exception as e:  # noqa: BLE001 - infeasible candidate
             result.ok = False
             result.error = f"{type(e).__name__}: {e}"
@@ -624,6 +632,8 @@ class StrategySearchEngine:
         seq_len: int = 2048,
         max_dryruns: int = 6,
         search_algo: str = "greedy",
+        try_low_precision: bool = False,
+        loss_parity_tol: float = 0.05,
         **candidate_kwargs,
     ):
         if search_algo not in ("greedy", "bo"):
@@ -635,10 +645,26 @@ class StrategySearchEngine:
         self._dry_runner = dry_runner
         self._max_dryruns = max_dryruns
         self._algo = search_algo
+        self._loss_parity_tol = loss_parity_tol
         self._candidates = candidate_strategies(
             n_devices, analysis, devices_per_host=devices_per_host,
             hbm_gb=hbm_gb, seq_len=seq_len, **candidate_kwargs,
         )
+        if try_low_precision:
+            # int8 variants of the top candidates: measured selection
+            # (reference Fp8Optimization is a production win via
+            # TransformerEngine, amp_optimization.py:197; TPU-native
+            # equivalent = int8 2x-MXU quantized einsums). An int8
+            # candidate may only WIN if its measured loss stays within
+            # loss_parity_tol of the same mesh's unquantized run — the
+            # gate lives in search()/best_strategy().
+            quant = [
+                dataclasses.replace(s, compute_dtype="int8")
+                for s in self._candidates[:2]
+            ]
+            self._candidates = (
+                self._candidates[:2] + quant + self._candidates[2:]
+            )
         self._bo = (
             BayesianSearch(self._candidates) if search_algo == "bo"
             else None
@@ -689,7 +715,7 @@ class StrategySearchEngine:
         if not ok:
             logger.warning("all dry-runs failed; using top candidate")
             return self._candidates[0]
-        best = min(ok, key=lambda r: r.step_s)
+        best = self._pick_best(ok)
         corr = cost_model_rank_correlation(
             self._candidates, self._results
         )
@@ -738,10 +764,68 @@ class StrategySearchEngine:
         if self._bo is not None and 0 <= task_id < len(self._candidates):
             self._bo.observe(task_id, result.step_s, result.ok)
 
+    def _pick_best(self, ok: list["DryRunResult"]) -> "DryRunResult":
+        """Fastest measured candidate, with the quantization gate: an
+        int8/fp8 candidate may only win when its measured loss matches
+        the same mesh+remat's unquantized run within loss_parity_tol
+        (quantization changes numerics; a fast-but-wrong step must not
+        be auto-selected). Gated candidates are skipped, not fatal."""
+
+        def is_quant(r):
+            return r.strategy.compute_dtype in ("int8", "fp8")
+
+        def sibling(r):
+            for o in ok:
+                if (
+                    not is_quant(o)
+                    and o.strategy.mesh == r.strategy.mesh
+                    and o.strategy.remat == r.strategy.remat
+                ):
+                    return o
+            return None
+
+        pool = list(ok)
+        while pool:
+            best = min(pool, key=lambda r: r.step_s)
+            if not is_quant(best):
+                return best
+            sib = sibling(best)
+            if (
+                sib is not None
+                and best.loss is not None
+                and sib.loss is not None
+                and abs(best.loss - sib.loss)
+                <= self._loss_parity_tol * max(abs(sib.loss), 1e-9)
+            ):
+                logger.info(
+                    "quantized dtype selected: %s at %.4fs/step "
+                    "(unquantized sibling %.4fs, loss %.4f vs %.4f)",
+                    best.strategy.compute_dtype, best.step_s,
+                    sib.step_s, best.loss, sib.loss,
+                )
+                return best
+            logger.info(
+                "quantized candidate %s gated off (no loss-parity "
+                "evidence)", best.strategy.describe(),
+            )
+            pool = [r for r in pool if r is not best]
+        # every measured candidate was a gated-off quantized one (e.g.
+        # all unquantized dry-runs OOMed): fall back to the cost-model
+        # top UNQUANTIZED candidate rather than silently selecting a
+        # strategy the gate just rejected
+        for s in self._candidates:
+            if s.compute_dtype not in ("int8", "fp8"):
+                logger.warning(
+                    "no parity-checked candidate succeeded; falling "
+                    "back to unquantized cost-model top %s", s.describe(),
+                )
+                return DryRunResult(strategy=s, ok=False)
+        return min(ok, key=lambda r: r.step_s)
+
     def best_strategy(self) -> Strategy:
         ok = [r for r in self._results if r.ok]
         if ok:
-            return min(ok, key=lambda r: r.step_s).strategy
+            return self._pick_best(ok).strategy
         if self._candidates:
             return self._candidates[0]
         return auto_strategy(self._n_devices, self._analysis.param_count)

@@ -271,7 +271,20 @@ class CheckpointEngine:
 
     def _write_shm_locked(self, step: int, state_dict) -> int:
         """D2H-copy the selected shards and write them into shm. Caller
-        holds the shm lock. Returns total bytes written."""
+        holds the shm lock. Returns total bytes written.
+
+        The drain is CHUNKED and DOUBLE-BUFFERED: every shard's D2H
+        transfer is launched up-front (``copy_to_host_async``), metas
+        are computed from shapes alone, and then shards are drained one
+        at a time — materialise shard i (blocks only on *its* in-flight
+        transfer) and memcpy it into shm (native, GIL-released, 8 MB
+        chunks across threads) while shards i+1.. are still streaming
+        over the link. Peak extra host memory is ~one shard instead of
+        the whole state, and the shm-copy leg hides entirely behind the
+        device link whenever link bandwidth < host memcpy bandwidth
+        (reference ckpt_saver.py's _traverse_copy_to_shm drains
+        tensor-by-tensor for the same reason).
+        """
         import jax
 
         names, leaves, _treedef = _tree_flatten_with_names(state_dict)
@@ -281,22 +294,24 @@ class CheckpointEngine:
                 leaf.copy_to_host_async()
         metas: list[LeafMeta] = []
         offset = 0
-        shard_arrays = []
+        shard_refs: list = []  # device shards or host arrays, unmaterialised
         for name, leaf in zip(names, leaves):
             for index, data in self._select_shards(leaf):
-                host_arr = np.asarray(data)
+                shape = tuple(np.shape(data))
+                dtype = np.dtype(getattr(data, "dtype", np.float32))
+                nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
                 meta = LeafMeta(
                     path=name,
-                    dtype=str(host_arr.dtype),
-                    shape=tuple(host_arr.shape),
+                    dtype=str(dtype),
+                    shape=shape,
                     offset=offset,
-                    nbytes=host_arr.nbytes,
+                    nbytes=nbytes,
                     global_shape=tuple(np.shape(leaf)),
-                    index=_index_to_meta(index, host_arr.ndim),
+                    index=_index_to_meta(index, len(shape)),
                 )
                 metas.append(meta)
-                shard_arrays.append(host_arr)
-                offset += host_arr.nbytes
+                shard_refs.append(data)
+                offset += nbytes
         ckpt_meta = CheckpointMeta(
             step=step,
             leaves=metas,
@@ -312,12 +327,12 @@ class CheckpointEngine:
         # per-shard numpy copy when the native lib is unavailable.
         from dlrover_tpu import native as dlrtpu_native
 
-        parts = [
-            (meta.offset, host_arr)
-            for meta, host_arr in zip(metas, shard_arrays)
-        ]
-        if not dlrtpu_native.scatter_copy(buf, parts):
-            for meta, host_arr in zip(metas, shard_arrays):
+        for i, meta in enumerate(metas):
+            host_arr = np.ascontiguousarray(np.asarray(shard_refs[i]))
+            shard_refs[i] = None  # bound host footprint to ~one shard
+            if not dlrtpu_native.scatter_copy(
+                buf, [(meta.offset, host_arr)]
+            ):
                 dst = np.frombuffer(
                     buf, dtype=np.uint8, count=meta.nbytes,
                     offset=meta.offset,

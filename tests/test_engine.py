@@ -591,3 +591,92 @@ class TestOffloadRemat:
             "reduce_precision" in line and "<host>" not in line
             for line in out.splitlines()
         ), out
+
+
+class TestLowPrecisionSelection:
+    """Measured int8 selection with the loss-parity gate (reference
+    Fp8Optimization amp_optimization.py:197 ships low precision as a
+    production win; TPU-native = int8 2x-MXU einsums, selected only
+    when the dry-runner proves faster AND loss-equivalent)."""
+
+    class FakeRunner:
+        """step time & loss keyed by compute_dtype."""
+
+        def __init__(self, times, losses):
+            self.times = times
+            self.losses = losses
+
+        def profile(self, strategy):
+            return DryRunResult(
+                strategy=strategy,
+                step_s=self.times[strategy.compute_dtype],
+                loss=self.losses[strategy.compute_dtype],
+                ok=True,
+            )
+
+    def _engine(self, times, losses):
+        return StrategySearchEngine(
+            8, small_analysis(),
+            dry_runner=self.FakeRunner(times, losses),
+            try_low_precision=True, max_dryruns=8,
+        )
+
+    def test_int8_variants_proposed(self):
+        eng = self._engine(
+            {"bfloat16": 0.1, "int8": 0.09},
+            {"bfloat16": 2.0, "int8": 2.01},
+        )
+        dtypes = {s.compute_dtype for s in eng.candidates}
+        assert dtypes == {"bfloat16", "int8"}
+
+    def test_int8_wins_with_loss_parity(self):
+        eng = self._engine(
+            {"bfloat16": 0.10, "int8": 0.09},
+            {"bfloat16": 2.00, "int8": 2.02},  # within 5%
+        )
+        best = eng.search()
+        assert best.compute_dtype == "int8"
+
+    def test_int8_gated_without_loss_parity(self):
+        eng = self._engine(
+            {"bfloat16": 0.10, "int8": 0.08},
+            {"bfloat16": 2.00, "int8": 2.50},  # 25% off: numerics broke
+        )
+        best = eng.search()
+        assert best.compute_dtype == "bfloat16"
+
+    def test_int8_not_selected_when_slower(self):
+        eng = self._engine(
+            {"bfloat16": 0.10, "int8": 0.12},
+            {"bfloat16": 2.00, "int8": 2.00},
+        )
+        best = eng.search()
+        assert best.compute_dtype == "bfloat16"
+
+    def test_default_engine_stays_bf16_only(self):
+        eng = StrategySearchEngine(8, small_analysis())
+        assert all(
+            s.compute_dtype == "bfloat16" for s in eng.candidates
+        )
+
+    def test_all_unquantized_failed_falls_back_to_cost_model(self):
+        """When only gated-off quantized results succeeded, the engine
+        must fall back to an unquantized candidate, never silently
+        select the strategy the parity gate just rejected."""
+
+        class Bf16FailRunner:
+            def profile(self, strategy):
+                if strategy.compute_dtype == "int8":
+                    return DryRunResult(
+                        strategy=strategy, step_s=0.08, loss=2.0, ok=True
+                    )
+                return DryRunResult(
+                    strategy=strategy, ok=False, error="OOM"
+                )
+
+        eng = StrategySearchEngine(
+            8, small_analysis(), dry_runner=Bf16FailRunner(),
+            try_low_precision=True, max_dryruns=8,
+        )
+        best = eng.search()
+        assert best.compute_dtype == "bfloat16"
