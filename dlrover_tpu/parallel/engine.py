@@ -715,7 +715,7 @@ class StrategySearchEngine:
         if not ok:
             logger.warning("all dry-runs failed; using top candidate")
             return self._candidates[0]
-        best = self._pick_best(ok)
+        best = self._pick_best(ok, verbose=True)
         corr = cost_model_rank_correlation(
             self._candidates, self._results
         )
@@ -727,10 +727,18 @@ class StrategySearchEngine:
                 " (weak: analytic ordering unreliable here beyond "
                 "memory feasibility)",
             )
-        logger.info(
-            "strategy search: %s wins (%.4fs/step over %d candidates)",
-            best.strategy.describe(), best.step_s, len(ok),
-        )
+        if best.ok:
+            logger.info(
+                "strategy search: %s wins (%.4fs/step over %d "
+                "candidates)", best.strategy.describe(), best.step_s,
+                len(ok),
+            )
+        else:
+            logger.warning(
+                "strategy search: falling back to unmeasured %s (no "
+                "parity-checked candidate succeeded)",
+                best.strategy.describe(),
+            )
         self._finished = True
         return best.strategy
 
@@ -764,12 +772,16 @@ class StrategySearchEngine:
         if self._bo is not None and 0 <= task_id < len(self._candidates):
             self._bo.observe(task_id, result.step_s, result.ok)
 
-    def _pick_best(self, ok: list["DryRunResult"]) -> "DryRunResult":
+    def _pick_best(
+        self, ok: list["DryRunResult"], verbose: bool = False
+    ) -> "DryRunResult":
         """Fastest measured candidate, with the quantization gate: an
         int8/fp8 candidate may only win when its measured loss matches
         the same mesh+remat's unquantized run within loss_parity_tol
         (quantization changes numerics; a fast-but-wrong step must not
-        be auto-selected). Gated candidates are skipped, not fatal."""
+        be auto-selected). Gated candidates are skipped, not fatal.
+        ``verbose`` logs decisions at info (the one search() call);
+        repeated best_strategy()/task-loop calls stay quiet."""
 
         def is_quant(r):
             return r.strategy.compute_dtype in ("int8", "fp8")
@@ -797,17 +809,19 @@ class StrategySearchEngine:
                 and abs(best.loss - sib.loss)
                 <= self._loss_parity_tol * max(abs(sib.loss), 1e-9)
             ):
-                logger.info(
-                    "quantized dtype selected: %s at %.4fs/step "
-                    "(unquantized sibling %.4fs, loss %.4f vs %.4f)",
-                    best.strategy.compute_dtype, best.step_s,
-                    sib.step_s, best.loss, sib.loss,
-                )
+                if verbose:
+                    logger.info(
+                        "quantized dtype selected: %s at %.4fs/step "
+                        "(unquantized sibling %.4fs, loss %.4f vs %.4f)",
+                        best.strategy.compute_dtype, best.step_s,
+                        sib.step_s, best.loss, sib.loss,
+                    )
                 return best
-            logger.info(
-                "quantized candidate %s gated off (no loss-parity "
-                "evidence)", best.strategy.describe(),
-            )
+            if verbose:
+                logger.info(
+                    "quantized candidate %s gated off (no loss-parity "
+                    "evidence)", best.strategy.describe(),
+                )
             pool = [r for r in pool if r is not best]
         # every measured candidate was a gated-off quantized one (e.g.
         # all unquantized dry-runs OOMed): fall back to the cost-model
@@ -815,10 +829,12 @@ class StrategySearchEngine:
         # strategy the gate just rejected
         for s in self._candidates:
             if s.compute_dtype not in ("int8", "fp8"):
-                logger.warning(
-                    "no parity-checked candidate succeeded; falling "
-                    "back to unquantized cost-model top %s", s.describe(),
-                )
+                if verbose:
+                    logger.warning(
+                        "no parity-checked candidate succeeded; falling "
+                        "back to unquantized cost-model top %s",
+                        s.describe(),
+                    )
                 return DryRunResult(strategy=s, ok=False)
         return min(ok, key=lambda r: r.step_s)
 

@@ -102,6 +102,7 @@ def auto_strategy(
     moe: bool = False,
     n_experts: int = 1,
     long_context_threshold: int = 32768,
+    n_slices: int = 1,
 ) -> Strategy:
     """Deterministic planner (the atorch auto_config analogue).
 
@@ -115,6 +116,11 @@ def auto_strategy(
     - Activate ``seq`` (ring attention) for very long sequences.
     - Activate ``expert`` for MoE models (expert count capped at device
       count).
+    - Multi-slice (``n_slices > 1``): the slice boundary rides the
+      ``data`` axis (pure DP over DCN — one gradient allreduce per
+      step), carved out of the fsdp extent; per-slice FSDP stays on
+      ICI. For finer control use the search engine's DCN-aware
+      candidates (engine.candidate_strategies(n_slices=...)).
     """
     param_bytes = param_count * 4.0  # fp32 master params
     hbm = hbm_gb * (1 << 30)
@@ -141,10 +147,27 @@ def auto_strategy(
             expert -= 1
 
     fsdp = n_devices // (tensor * seq * expert)
+    data = 1
+    dcn_data = 1
+    if n_slices > 1:
+        if fsdp % n_slices != 0:
+            raise ValueError(
+                f"{n_slices} slices do not divide the fsdp extent "
+                f"{fsdp} (n_devices={n_devices}, tensor={tensor}, "
+                f"seq={seq}, expert={expert})"
+            )
+        # DP across slices (gradient allreduce tolerates DCN), FSDP
+        # within each slice (param all-gathers stay on ICI)
+        data = n_slices
+        dcn_data = n_slices
+        fsdp //= n_slices
     mesh = MeshConfig(
-        pipe=1, data=1, fsdp=fsdp, expert=expert, seq=seq, tensor=tensor
+        pipe=1, data=data, fsdp=fsdp, expert=expert, seq=seq,
+        tensor=tensor, dcn_data=dcn_data,
     )
-    remat = _remat_for(param_bytes / n_devices, hbm)
+    # params are REPLICATED across the data (slice) axis: the per-device
+    # model-state share divides by the sharded extents only
+    remat = _remat_for(param_bytes / (n_devices // max(n_slices, 1)), hbm)
     strategy = Strategy(mesh=mesh, remat=remat)
     logger.info("auto_strategy: %s", strategy.describe())
     return strategy

@@ -998,14 +998,17 @@ class ReplicatedCheckpointEngine(CheckpointEngine):
 
         if isinstance(arr, jax.Array):
             # take one full copy (first addressable shard covers the
-            # array when replicated; otherwise gather to host)
+            # array when replicated; otherwise gather to host).
+            # Metadata-only shape read: np.asarray here would block on
+            # and host-materialize every leaf during the meta pass,
+            # defeating the chunked drain's one-shard host footprint.
             shards = _unique_addressable_shards(arr)
             if (
                 len(shards) == 1
-                and np.asarray(shards[0][1]).shape == tuple(arr.shape)
+                and tuple(np.shape(shards[0][1])) == tuple(arr.shape)
             ):
                 return [(None, shards[0][1])]
-            return [(None, np.asarray(arr))]
+            return [(None, arr)]
         return [(None, np.asarray(arr))]
 
     def save_to_memory(self, step: int, state_dict) -> bool:

@@ -233,3 +233,15 @@ def test_hybrid_mismatch_with_real_process_structure_raises(monkeypatch):
             {a: 1 for a in mesh_mod.AXIS_ORDER} | {"data": 2, "fsdp": 4},
             {"data": 2},
         )
+
+
+def test_auto_strategy_multi_slice():
+    from dlrover_tpu.parallel import auto_strategy
+
+    s = auto_strategy(n_devices=16, param_count=1_000_000_000, n_slices=2)
+    assert s.mesh.data == 2 and s.mesh.dcn_data == 2
+    assert s.mesh.n_slices == 2
+    assert s.mesh.fsdp == 8
+
+    with pytest.raises(ValueError):
+        auto_strategy(n_devices=9, param_count=1_000_000, n_slices=2)
