@@ -829,12 +829,7 @@ class StrategySearchEngine:
         # strategy the gate just rejected
         for s in self._candidates:
             if s.compute_dtype not in ("int8", "fp8"):
-                if verbose:
-                    logger.warning(
-                        "no parity-checked candidate succeeded; falling "
-                        "back to unquantized cost-model top %s",
-                        s.describe(),
-                    )
+                # search() logs the fallback (it branches on best.ok)
                 return DryRunResult(strategy=s, ok=False)
         return min(ok, key=lambda r: r.step_s)
 

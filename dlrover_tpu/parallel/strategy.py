@@ -126,8 +126,10 @@ def auto_strategy(
     hbm = hbm_gb * (1 << 30)
 
     tensor = 1
-    # With pure FSDP over all devices, per-device footprint:
-    per_dev = param_bytes * 4 / n_devices
+    # With pure FSDP over all devices IN ONE SLICE (params replicate
+    # across slices), per-device footprint:
+    sharded_devices = n_devices // max(n_slices, 1)
+    per_dev = param_bytes * 4 / max(sharded_devices, 1)
     if per_dev > hbm * 0.5:
         tensor = min(devices_per_host, n_devices)
 

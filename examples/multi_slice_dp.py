@@ -116,7 +116,14 @@ def main() -> None:
         )
         for i in range(N_PROCS)
     ]
-    rcs = [q.wait(timeout=600) for q in procs]
+    try:
+        rcs = [q.wait(timeout=600) for q in procs]
+    finally:
+        # a dead sibling leaves the survivor blocked in a collective:
+        # never orphan it
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
     if any(rcs):
         raise SystemExit(f"worker exit codes {rcs}")
     print("multi-slice example ok")

@@ -67,3 +67,21 @@ def test_example_runs(args, tmp_path):
         run_example(args, job, tmp_path)
     finally:
         _cleanup_job_shm(job)
+
+
+def test_multi_slice_example_runs(tmp_path):
+    """multi_slice_dp spawns its own jax.distributed processes (one per
+    simulated slice), so it runs directly rather than through tpu-run;
+    the parent env must not force a device count onto the workers."""
+    env = {**os.environ, "PYTHONPATH": REPO}
+    for k in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS", "JAX_PLATFORMS"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "examples/multi_slice_dp.py"],
+        env=env, cwd=REPO, capture_output=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        proc.stdout.decode()[-2000:] + "\n--- stderr ---\n"
+        + proc.stderr.decode()[-2000:]
+    )
+    assert b"multi-slice example ok" in proc.stdout
