@@ -454,11 +454,17 @@ def main():
         synth_zc = engine.load(zero_copy=True)
         restore_shm_headline_s = time.perf_counter() - t0
         assert synth_zc, "headline shm restore empty"
-        t0 = time.perf_counter()
-        synth_copy = engine.load()
-        restore_shm_headline_copy_s = time.perf_counter() - t0
-        assert synth_copy, "headline shm copy-restore empty"
-        del synth_zc, synth_copy
+        restore_shm_headline_copy_s = float("inf")
+        for _ in range(2):  # best-of-2: 1-core VM bandwidth variance
+            t0 = time.perf_counter()
+            synth_copy = engine.load()
+            restore_shm_headline_copy_s = min(
+                restore_shm_headline_copy_s, time.perf_counter() - t0
+            )
+            assert synth_copy, "headline shm copy-restore empty"
+            del synth_copy
+            gc.collect()
+        del synth_zc
         gc.collect()
 
         # shm scatter-copy stage in isolation: time the exact native
