@@ -215,13 +215,17 @@ def main():
     loss_int8 = float(m["loss"])
     t_int8 = (time.perf_counter() - t0) / steps
 
+    from dlrover_tpu.parallel.engine import LOSS_PARITY_TOL
+
     int8_vs_bf16_pct = (t_int8 / t_bf16 - 1.0) * 100
     loss_parity_pct = abs(loss_int8 - loss_bf16) / max(
         abs(loss_bf16), 1e-9
     ) * 100
-    # loss-parity gate (engine.py _pick_best semantics): int8 may only
-    # be selected when measurably faster AND loss-equivalent
-    int8_selected = t_int8 < t_bf16 and loss_parity_pct < 5.0
+    # loss-parity gate: same tolerance the engine's _pick_best ships,
+    # so the published selection measures the product policy
+    int8_selected = (
+        t_int8 < t_bf16 and loss_parity_pct < LOSS_PARITY_TOL * 100
+    )
     selected_dtype = "int8" if int8_selected else "bfloat16"
     if int8_selected:
         step_time, headline_loss = t_int8, loss_int8

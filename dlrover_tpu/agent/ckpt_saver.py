@@ -144,18 +144,39 @@ class SharedMemoryHandler:
             self._shm = None
         return self.attach()
 
-    def write_meta_and_reserve(self, meta: CheckpointMeta) -> memoryview:
-        """Write the meta header and return a view over the tensor area."""
+    def write_meta_and_reserve(
+        self, meta: CheckpointMeta, publish: bool = True
+    ) -> memoryview:
+        """Write the meta header and return a view over the tensor area.
+
+        ``publish=False`` stages the meta but leaves the length prefix
+        zeroed (readers see "no checkpoint") until :meth:`publish_meta`
+        — two-phase commit for drains that fill the tensor area over a
+        long window (chunked D2H): a preemption mid-drain must never
+        leave a valid meta over partial bytes, or the failure-path
+        save_shm_to_storage persists a torn snapshot and restore loads
+        mixed-step weights. The prefix itself is invalidated FIRST in
+        both modes so a crash between meta and data writes is also
+        unreadable.
+        """
         meta_bytes = pickle.dumps(meta)
         data_start = _META_LEN_SIZE + len(meta_bytes)
         total = data_start + meta.total_bytes
         self._ensure(total)
         buf = self._shm.buf
-        buf[:_META_LEN_SIZE] = len(meta_bytes).to_bytes(
+        buf[:_META_LEN_SIZE] = (0).to_bytes(_META_LEN_SIZE, "little")
+        buf[_META_LEN_SIZE : data_start] = meta_bytes
+        self._staged_meta_len = len(meta_bytes)
+        if publish:
+            self.publish_meta()
+        return buf[data_start : data_start + meta.total_bytes]
+
+    def publish_meta(self) -> None:
+        """Commit a staged meta: the single prefix-word write makes the
+        checkpoint visible atomically (readers re-validate by parsing)."""
+        self._shm.buf[:_META_LEN_SIZE] = self._staged_meta_len.to_bytes(
             _META_LEN_SIZE, "little"
         )
-        buf[_META_LEN_SIZE : data_start] = meta_bytes
-        return buf[data_start : data_start + meta.total_bytes]
 
     def read(self) -> tuple[CheckpointMeta, memoryview] | None:
         if self._shm is None and not self.attach():

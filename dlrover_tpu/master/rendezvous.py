@@ -240,6 +240,16 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         cached = self._groups_by_round.get(check_round)
         if cached is not None:
             return cached
+        # only rounds r and r-1 are ever read (fault intersection,
+        # victim filter, pairing memory): prune older history or a
+        # long-lived master leaks one grouping + two dicts per round
+        for store in (
+            self._groups_by_round,
+            self._node_status,
+            self._node_times_by_round,
+        ):
+            for old in [k for k in store if k < check_round - 1]:
+                del store[old]
         ranks = sorted(self._rdzv_nodes.keys())
         n = len(ranks)
         if n <= 2:
@@ -392,12 +402,15 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             )
 
     def _victims(self, fault: set, rounds) -> set:
-        """Nodes whose every failing round is explained by a strictly
-        slower co-member of the same probe group that is itself in the
-        fault set: collateral damage of a faulty partner (an unlucky
-        node can draw a different faulty partner twice in a row when
-        faulty nodes outnumber known-good ones), not faults. The faulty
-        node's own probe runs to timeout, so it is the slow one."""
+        """Nodes whose every failing round is explained by a co-member
+        of the same probe group that is itself in the fault set and
+        exhibits an EXTREME elapsed relative to the node: collateral
+        damage of a faulty partner (an unlucky node can draw a
+        different faulty partner twice in a row when faulty nodes
+        outnumber known-good ones), not faults. A faulty node shows up
+        at one of two extremes — its probe hangs to timeout (strictly
+        slower than the victim) or its device fails instantly (far
+        faster than the victim, who then waits out the collective)."""
 
         def explained(x, rnd):
             times = self._node_times_by_round.get(rnd, {})
@@ -407,8 +420,10 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             for group in self._groups_by_round.get(rnd, []):
                 if x in group:
                     return any(
-                        y != x and y in fault
-                        and times.get(y, 0.0) > tx
+                        y != x and y in fault and (
+                            times.get(y, 0.0) > tx
+                            or times.get(y, tx) < 0.25 * tx
+                        )
                         for y in group
                     )
             return False

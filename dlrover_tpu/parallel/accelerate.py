@@ -183,9 +183,9 @@ def compute_state_shardings(
         try:
             if jax.tree.structure(sub) != params_struct:
                 return False
+            leaves = jax.tree.leaves(sub)
         except Exception:  # noqa: BLE001 - exotic nodes: not a match
             return False
-        leaves = jax.tree.leaves(sub)
         return all(
             getattr(l, "shape", None) == p.shape
             and getattr(l, "dtype", None) == p.dtype

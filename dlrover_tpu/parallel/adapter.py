@@ -72,16 +72,13 @@ _VOCAB_PAT = re.compile(
 _LAYER_PAT = re.compile(r"(^|[._/])(layers?|blocks?|h)([._/]|$)")
 
 
-def _infer_hidden(leaves) -> int:
-    """Modal residual width: the smaller trailing dim of most weight
-    matrices (same structural vote as engine.analyse_params)."""
-    import collections
+def _infer_hidden(params) -> int:
+    """Modal residual width — delegates to the strategy analyser's
+    structural vote so the adapter and the search engine can never
+    disagree about the model width."""
+    from dlrover_tpu.parallel.engine import analyse_params
 
-    votes: collections.Counter = collections.Counter()
-    for _, shape in leaves:
-        if len(shape) >= 2:
-            votes[int(min(shape[-2], shape[-1]))] += 1
-    return votes.most_common(1)[0][0] if votes else 0
+    return analyse_params(params).hidden
 
 
 def _axes_for_leaf(name: str, shape, hidden: int, vocab: int,
@@ -168,7 +165,7 @@ def infer_logical_axes(params, vocab_size: Optional[int] = None,
             str(getattr(e, "key", getattr(e, "idx", e))) for e in path
         )
         named.append((name, tuple(getattr(leaf, "shape", ()))))
-    h = hidden or _infer_hidden(named)
+    h = hidden or _infer_hidden(params)
     # a subtree is "stacked" when its path names a layer container and
     # its leading dim is shared by every >=2D leaf under that container
     lead_dims = [

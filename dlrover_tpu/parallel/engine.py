@@ -27,6 +27,11 @@ from dlrover_tpu.parallel.strategy import Strategy, auto_strategy
 
 logger = get_logger(__name__)
 
+# default relative loss tolerance for selecting a quantized dtype —
+# shared with bench.py so the published selection measures the policy
+# the product ships
+LOSS_PARITY_TOL = 0.05
+
 
 # --------------------------------------------------------------------------
 # analyser (reference auto/analyser/analyser.py:14)
@@ -633,7 +638,7 @@ class StrategySearchEngine:
         max_dryruns: int = 6,
         search_algo: str = "greedy",
         try_low_precision: bool = False,
-        loss_parity_tol: float = 0.05,
+        loss_parity_tol: float = LOSS_PARITY_TOL,
         **candidate_kwargs,
     ):
         if search_algo not in ("greedy", "bo"):

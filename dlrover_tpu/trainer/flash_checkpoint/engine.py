@@ -297,8 +297,13 @@ class CheckpointEngine:
         shard_refs: list = []  # device shards or host arrays, unmaterialised
         for name, leaf in zip(names, leaves):
             for index, data in self._select_shards(leaf):
+                if getattr(data, "dtype", None) is None:
+                    # dtype-less leaf (python scalar from an exotic
+                    # _select_shards): materialise NOW so the reserved
+                    # nbytes can never diverge from the drained bytes
+                    data = np.asarray(data)
                 shape = tuple(np.shape(data))
-                dtype = np.dtype(getattr(data, "dtype", np.float32))
+                dtype = np.dtype(data.dtype)
                 nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
                 meta = LeafMeta(
                     path=name,
@@ -321,23 +326,48 @@ class CheckpointEngine:
             num_hosts=self._num_hosts,
             total_bytes=offset,
         )
-        buf = self._shm_handler.write_meta_and_reserve(ckpt_meta)
+        # two-phase: the meta stays unpublished (readers see "empty")
+        # until every byte is drained — a preemption mid-drain must not
+        # leave a valid meta over partial tensors
+        buf = self._shm_handler.write_meta_and_reserve(
+            ckpt_meta, publish=False
+        )
         # Hot path: native multi-threaded scatter copy (libdlrtpu) runs at
         # host memory bandwidth with the GIL released; falls back to the
         # per-shard numpy copy when the native lib is unavailable.
+        # Shards are materialised one at a time (bounds host memory and
+        # overlaps the remaining in-flight D2H transfers) but FLUSHED in
+        # batches so many small leaves still share one threaded native
+        # call.
         from dlrover_tpu import native as dlrtpu_native
+
+        flush_bytes = 64 << 20
+        pending: list = []
+        pending_bytes = 0
+
+        def _flush():
+            nonlocal pending, pending_bytes
+            if not pending:
+                return
+            if not dlrtpu_native.scatter_copy(buf, pending):
+                for off, host_arr in pending:
+                    dst = np.frombuffer(
+                        buf, dtype=np.uint8, count=host_arr.nbytes,
+                        offset=off,
+                    )
+                    np.copyto(dst, host_arr.reshape(-1).view(np.uint8))
+            pending = []
+            pending_bytes = 0
 
         for i, meta in enumerate(metas):
             host_arr = np.ascontiguousarray(np.asarray(shard_refs[i]))
-            shard_refs[i] = None  # bound host footprint to ~one shard
-            if not dlrtpu_native.scatter_copy(
-                buf, [(meta.offset, host_arr)]
-            ):
-                dst = np.frombuffer(
-                    buf, dtype=np.uint8, count=meta.nbytes,
-                    offset=meta.offset,
-                )
-                np.copyto(dst, host_arr.reshape(-1).view(np.uint8))
+            shard_refs[i] = None  # bound host footprint to ~one batch
+            pending.append((meta.offset, host_arr))
+            pending_bytes += host_arr.nbytes
+            if pending_bytes >= flush_bytes:
+                _flush()
+        _flush()
+        self._shm_handler.publish_meta()
         self._latest_step = step
         return offset
 

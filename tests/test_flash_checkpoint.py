@@ -577,3 +577,39 @@ class TestStorageCompleteness:
         with _pytest.raises(ValueError, match="missing"):
             e.load_from_storage(target=target)
         e.close()
+
+
+def test_two_phase_meta_publish(isolated_ckpt_env):
+    """A drain in progress must be invisible to readers: the meta stays
+    unpublished (read() -> None) until publish_meta(), so a preemption
+    mid-drain can never leave a valid meta over partial tensor bytes
+    (the failure-path save_shm_to_storage would persist a torn
+    snapshot)."""
+    import numpy as np
+
+    from dlrover_tpu.agent.ckpt_saver import (
+        CheckpointMeta,
+        LeafMeta,
+        SharedMemoryHandler,
+    )
+
+    h = SharedMemoryHandler(0)
+    arr = np.arange(16, dtype=np.float32)
+    meta = CheckpointMeta(
+        step=7,
+        leaves=[LeafMeta(
+            path="w", dtype="float32", shape=(16,), offset=0,
+            nbytes=arr.nbytes,
+        )],
+        treedef=b"", engine="replicated", total_bytes=arr.nbytes,
+    )
+    buf = h.write_meta_and_reserve(meta, publish=False)
+    assert h.read() is None, "unpublished meta must be invisible"
+    buf[: arr.nbytes] = arr.tobytes()
+    h.publish_meta()
+    got = h.read()
+    assert got is not None and got[0].step == 7
+    np.testing.assert_array_equal(
+        np.frombuffer(bytes(got[1][: arr.nbytes]), np.float32), arr
+    )
+    h.close(unlink=True)

@@ -359,3 +359,36 @@ def test_odd_count_triple_never_repeats_pairs():
     faults, _ = mgr.check_fault_node()
     assert set(faults) <= faulty, f"healthy node condemned: {faults}"
     assert faults, "faulty nodes never pinned"
+
+
+def test_fast_crashing_faulty_node_does_not_condemn_partner():
+    """A faulty node that fails INSTANTLY (tiny elapsed) while its
+    healthy partner waits out the collective must not drag the partner
+    into the fault set: the victim filter recognises both extremes
+    (timeout-slow and crash-fast) of a faulty co-member."""
+    mgr = NetworkCheckRendezvousManager()
+    mgr.update_rdzv_params(3, 3, 60, 1)
+    faulty = {2}
+
+    def drive():
+        for r in range(3):
+            mgr.join_rendezvous(r, 1)
+        for r in range(3):
+            mgr.get_comm_world(r)
+        groups = mgr._group_nodes(mgr._check_round)
+        for g in groups:
+            bad = set(g) & faulty
+            for r in g:
+                if r in faulty:
+                    mgr.report_network_check_result(r, False, 0.2)
+                elif bad:
+                    # healthy partner waits out the dead collective
+                    mgr.report_network_check_result(r, False, 60.0)
+                else:
+                    mgr.report_network_check_result(r, True, 1.0)
+
+    for _ in range(3):
+        drive()
+    faults, _ = mgr.check_fault_node()
+    assert set(faults) <= faulty, f"healthy node condemned: {faults}"
+    assert faults == [2]
