@@ -99,20 +99,40 @@ def _sparse_bench(on_tpu: bool) -> dict:
     # zipf-distributed ids (the sparse-feature reality the tier is built
     # for: hot ids stay device-resident, the cold tail lives on the
     # host) — a uniform draw would promote ~the whole batch every step
-    # and measure only this environment's device link latency
+    # and measure only this environment's device link latency. The
+    # whole 4x vocab is imported up front: the device table FILLS and
+    # 3x capacity spills to the host tier, so every timed step runs the
+    # real demote/promote round-trip instead of cold-table inserts.
     tiered = TieredKvEmbedding(dim=dim, capacity=cap)
     ttable = tiered.init_table(jax.random.key(1))
     big_vocab = rs.randint(0, 1 << 40, size=4 * cap)
+    ttable = tiered.import_(
+        ttable, big_vocab,
+        (rs.randn(big_vocab.size, dim) * 0.01).astype(np.float32),
+    )
+    assert tiered.host_ids > 0, "tiered import did not overflow"
 
+    # exponent 1.5: ~0.4% of draws land past the device-resident head
+    # at bench capacity — tens of demote/promote rows per step, so the
+    # timed loop measures the tiering machinery with the spill path
+    # continuously live. Heavier tails just scale the rows moved per
+    # step, which on this environment's ~5 MB/s tunnel re-measures the
+    # link (disclosed in device_link_*), not the tier.
     def zipf_ids(n):
         ranks = np.minimum(
-            rs.zipf(1.3, size=n), len(big_vocab)
+            rs.zipf(1.5, size=n), len(big_vocab)
         ) - 1
         return big_vocab[ranks]
 
-    ttable, tslots = tiered.prepare_batch(ttable, zipf_ids(batch))
-    ttable = sgd_step(ttable, jnp.asarray(tslots))
+    # warmup compiles the bucketed gather/scatter variants the zipf
+    # demote/promote traffic actually hits (power-of-two buckets: a
+    # handful of sizes) so the timed loop measures steady state, not
+    # compilation
+    for _ in range(4):
+        ttable, tslots = tiered.prepare_batch(ttable, zipf_ids(batch))
+        ttable = sgd_step(ttable, jnp.asarray(tslots))
     jax.block_until_ready(ttable)
+    c0 = dict(tiered.counters)
     t0 = time.perf_counter()
     for _ in range(steps):
         ttable, tslots = tiered.prepare_batch(ttable, zipf_ids(batch))
@@ -125,6 +145,12 @@ def _sparse_bench(on_tpu: bool) -> dict:
         "sparse_dense_gather_mrows_s": round(dense_rows_s / 1e6, 3),
         "sparse_tiered_mrows_s": round(tiered_rows_s / 1e6, 3),
         "sparse_tier_host_rows": tiered.host_ids,
+        "sparse_tier_demoted_rows":
+            tiered.counters["demoted_rows"] - c0["demoted_rows"],
+        "sparse_tier_promoted_rows":
+            tiered.counters["promoted_rows"] - c0["promoted_rows"],
+        "sparse_tier_fresh_rows":
+            tiered.counters["fresh_rows"] - c0["fresh_rows"],
         "sparse_dim_capacity_batch": f"{dim}x{cap} B{batch}",
     }
 
@@ -260,6 +286,10 @@ def main():
     # Prometheus export): profile a short window on the SELECTED arm,
     # publish the top ops, serve them from the agent's /metrics endpoint
     top_ops, kernel_metrics_served = [], False
+    # None = gate not run (remat!=none) or no profiled ops to inspect;
+    # True/False only when an op list was actually checked
+    remat_none_checkpoint_free = None
+    remat_none_checkpoint_detail = ""
     prof_dir = tempfile.mkdtemp(prefix="bench_prof_")
     try:
         from dlrover_tpu.agent.monitor import MetricsEndpoint
@@ -277,6 +307,25 @@ def main():
             state, m = res.train_step(
                 state, {"tokens": h_tokens}, jax.random.key(500 + i))
             prof.maybe_stop(i, block_on=m["loss"])
+        # profiler-hook gate: a remat=none step must profile free of
+        # checkpoint calls (a leak here charged 25.7 ms/step before the
+        # quant-aware gate). The fused CE keeps ONE intentional
+        # jax.checkpoint when ce_chunks>1 (a logits-memory feature, not
+        # remat policy), so the hook's verdict — including any
+        # surviving op list — is published in the JSON rather than
+        # aborting the bench on the known call.
+        if strategy.remat == "none":
+            try:
+                n_ops = prof.assert_ops_absent(("checkpoint",))
+                if n_ops:
+                    remat_none_checkpoint_free = True
+                else:
+                    remat_none_checkpoint_detail = (
+                        "no profiled ops available to inspect"
+                    )
+            except AssertionError as err:
+                remat_none_checkpoint_free = False
+                remat_none_checkpoint_detail = str(err)[-240:]
         endpoint = MetricsEndpoint(exporter=None, host="127.0.0.1")
         port = endpoint.start()
         try:
@@ -439,15 +488,18 @@ def main():
         assert engine.save_to_memory(3, synth), "engine save skipped"
         cold_s = time.perf_counter() - t0
         ckpt_engine_cold_gbps = synth_total / cold_s / (1 << 30)
-        # best of 3 warm saves: this environment is a 1-core VM with
-        # up to 10x memory-bandwidth variance from host steal — the
-        # best run reflects the engine, the others the neighbor
-        best = float("inf")
+        # median of 3 warm saves, min/max published alongside: this
+        # environment is a 1-core VM with up to 10x memory-bandwidth
+        # variance from host steal — the spread makes the neighbor
+        # noise visible instead of silently selecting the best sample
+        warm_ts = []
         for i in range(3):
             t0 = time.perf_counter()
             assert engine.save_to_memory(4 + i, synth), "save skipped"
-            best = min(best, time.perf_counter() - t0)
-        ckpt_engine_gbps = synth_total / best / (1 << 30)
+            warm_ts.append(time.perf_counter() - t0)
+        warm_ts.sort()
+        ckpt_engine_save_s_minmax = [warm_ts[0], warm_ts[-1]]
+        ckpt_engine_gbps = synth_total / warm_ts[1] / (1 << 30)
         del synth  # load() reads shm; bound peak host memory
         gc.collect()
         # restore at HEADLINE size from the host path (shm): the
@@ -458,16 +510,17 @@ def main():
         synth_zc = engine.load(zero_copy=True)
         restore_shm_headline_s = time.perf_counter() - t0
         assert synth_zc, "headline shm restore empty"
-        restore_shm_headline_copy_s = float("inf")
-        for _ in range(2):  # best-of-2: 1-core VM bandwidth variance
+        copy_ts = []
+        for _ in range(3):  # median-of-3: 1-core VM bandwidth variance
             t0 = time.perf_counter()
             synth_copy = engine.load()
-            restore_shm_headline_copy_s = min(
-                restore_shm_headline_copy_s, time.perf_counter() - t0
-            )
+            copy_ts.append(time.perf_counter() - t0)
             assert synth_copy, "headline shm copy-restore empty"
             del synth_copy
             gc.collect()
+        copy_ts.sort()
+        restore_shm_headline_copy_s = copy_ts[1]
+        restore_shm_headline_copy_s_minmax = [copy_ts[0], copy_ts[-1]]
         del synth_zc
         gc.collect()
 
@@ -559,17 +612,26 @@ def main():
             # full engine path over a host-resident headline-sized
             # state: engine-limited, vs device_link_* = link ceiling.
             # warm = steady-state (segment reused every save); cold
-            # pays one-time single-core tmpfs fault-in of a new segment
+            # pays one-time single-core tmpfs fault-in of a new segment.
+            # gbps is the MEDIAN of 3 warm saves; the _minmax spread
+            # shows this 1-core VM's neighbor-steal variance
             "ckpt_engine_gbps": round(ckpt_engine_gbps, 2),
+            "ckpt_engine_save_s_minmax": [
+                round(t, 3) for t in ckpt_engine_save_s_minmax
+            ],
             "ckpt_engine_cold_gbps": round(ckpt_engine_cold_gbps, 2),
             "ckpt_engine_synth_gb": round(synth_total / (1 << 30), 2),
             "restore_shm_s": round(restore_shm_s, 3),
             "restore_shm_copy_s": round(restore_shm_copy_s, 3),
-            # host-path restore at headline state size (<10 s north star)
+            # host-path restore at headline state size (<10 s north
+            # star); copy_s is the median of 3 with min/max spread
             "restore_shm_headline_s": round(restore_shm_headline_s, 3),
             "restore_shm_headline_copy_s": round(
                 restore_shm_headline_copy_s, 3
             ),
+            "restore_shm_headline_copy_s_minmax": [
+                round(t, 3) for t in restore_shm_headline_copy_s_minmax
+            ],
             "restore_disk_s": round(restore_disk_s, 3),
             "restore_h2d_s": round(restore_h2d_s, 3),
             "ckpt_saver_path": saver_path,
@@ -582,6 +644,13 @@ def main():
             "fp8_vs_bf16_step_pct": round(fp8_vs_bf16_pct, 2),
             "kernel_metrics_served": kernel_metrics_served,
             "top_ops": top_ops,
+            # True = the profiled remat=none window was inspected and
+            # contained no checkpoint op; False = inspected and leaked
+            # (_detail lists the survivors — the fused CE's intentional
+            # jax.checkpoint is the one expected entry at ce_chunks>1);
+            # null = gate not run (remat!=none, or no profiled ops)
+            "remat_none_checkpoint_free": remat_none_checkpoint_free,
+            "remat_none_checkpoint_detail": remat_none_checkpoint_detail,
             **sparse,
             "backend": jax.default_backend(),
         },

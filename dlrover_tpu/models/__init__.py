@@ -17,6 +17,15 @@ from dlrover_tpu.models.llama import (  # noqa: F401
     PRESETS,
 )
 
+from dlrover_tpu.models.recsys import (  # noqa: F401
+    RecsysConfig,
+    TieredBatchPreparer,
+    make_tiered_embedding,
+    recsys_init,
+    recsys_logical_axes,
+    recsys_loss_fn,
+)
+
 from dlrover_tpu.models.gpt2 import (  # noqa: F401
     GPT2Config,
     GPT2_PRESETS,

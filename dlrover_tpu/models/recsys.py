@@ -1,0 +1,197 @@
+"""Recommender-style model over a (tiered) KvEmbedding table.
+
+Equivalent capability: the reference's TFPlus sparse serving stack —
+KvVariable-backed embedding layers feeding a dense tower
+(tfplus/tfplus/kv_variable/python/ops/embedding_ops.py) with the hybrid
+host/device placement of hybrid_embedding/table_manager.h. TPU redesign:
+the embedding table is an ordinary ``[capacity, dim]`` param leaf
+(sharded on ``("vocab", "embed")`` like any other), the dense tower is a
+small MLP, and the *dynamic* id -> slot work happens on the host between
+steps via :class:`TieredBatchPreparer` — so the jitted train step built
+by auto_accelerate stays static-shaped and the elastic Trainer can drive
+a vocabulary far larger than device memory.
+
+Usage with the elastic trainer::
+
+    cfg = RecsysConfig(dim=32, device_capacity=1 << 12)
+    kv = make_tiered_embedding(cfg)
+    trainer = Trainer(
+        recsys_loss_fn(cfg), lambda rng: recsys_init(cfg, rng, kv),
+        recsys_logical_axes(cfg), args, train_data,
+        prestep=TieredBatchPreparer(kv),
+    )
+    # train_data yields {"ids": [B, F] raw int64, "labels": [B] float32}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.ops.sparse_embedding import (
+    KvEmbedding,
+    TieredKvEmbedding,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RecsysConfig:
+    dim: int = 32                 # embedding width
+    device_capacity: int = 1 << 12  # rows resident on device
+    fields: int = 8               # sparse features per example
+    hidden: int = 64              # dense-tower width
+    init_scale: float = 0.01
+    seed: int = 0
+
+
+def make_tiered_embedding(config: RecsysConfig) -> TieredKvEmbedding:
+    return TieredKvEmbedding(
+        dim=config.dim,
+        capacity=config.device_capacity,
+        init_scale=config.init_scale,
+        seed=config.seed,
+    )
+
+
+def recsys_init(config: RecsysConfig, rng,
+                kv: KvEmbedding | None = None) -> dict:
+    """Params: the embedding table leaf + a two-layer dense tower."""
+    k_tbl, k1, k2 = jax.random.split(rng, 3)
+    if kv is not None:
+        table = kv.init_table(k_tbl)
+    else:
+        table = (
+            jax.random.normal(
+                k_tbl, (config.device_capacity, config.dim), jnp.float32
+            ) * config.init_scale
+        )
+    d, h = config.dim, config.hidden
+    return {
+        "table": table,
+        "w1": jax.random.normal(k1, (d, h), jnp.float32) * (d ** -0.5),
+        "b1": jnp.zeros((h,), jnp.float32),
+        "w2": jax.random.normal(k2, (h, 1), jnp.float32) * (h ** -0.5),
+        "b2": jnp.zeros((1,), jnp.float32),
+    }
+
+
+def recsys_logical_axes(config: RecsysConfig) -> dict:
+    return {
+        "table": ("vocab", "embed"),
+        "w1": ("embed", "mlp"),
+        "b1": ("mlp",),
+        "w2": ("mlp", None),
+        "b2": (None,),
+    }
+
+
+def recsys_loss_fn(config: RecsysConfig):
+    """Batch ``{"slots": [B, F] int32, "labels": [B] float32}`` ->
+    sigmoid BCE. ``slots`` are device-table rows — the preparer (or a
+    plain ``kv.lookup_slots``) maps raw ids to slots on the host."""
+    import optax
+
+    def loss_fn(params, batch, rng):
+        del rng
+        vecs = KvEmbedding.embed(params["table"], batch["slots"])
+        pooled = jnp.mean(vecs, axis=1)               # [B, D]
+        hdn = jax.nn.relu(pooled @ params["w1"] + params["b1"])
+        logits = (hdn @ params["w2"] + params["b2"]).squeeze(-1)
+        return jnp.mean(
+            optax.sigmoid_binary_cross_entropy(logits, batch["labels"])
+        )
+
+    return loss_fn
+
+
+class TieredBatchPreparer:
+    """Host-side pre-step hook: make a raw-id batch device-resident.
+
+    Pops ``batch["ids"]`` (raw int64, any shape), runs
+    ``kv.prepare_batch`` against the current table leaf — demoting cold
+    rows to the host tier and promoting the batch's spilled rows in one
+    bucketed gather/scatter round-trip — and hands back the updated
+    state plus the batch with ``"slots"`` in place of ``"ids"``.
+
+    Slot-aligned optimizer state moves with the rows: any opt_state
+    leaf living under the table's key with a ``[capacity, ...]``
+    leading dim (Adam moments, per-row accumulators) is passed to
+    ``prepare_batch`` as aux, so a demoted id's moments spill with its
+    row and return with it — otherwise a promoted id would train with
+    the evicted victim's optimizer state.
+
+    Plugs into :class:`dlrover_tpu.trainer.trainer.Trainer` via its
+    ``prestep=`` argument; the jitted train step never sees a raw id.
+    """
+
+    def __init__(self, kv: TieredKvEmbedding, table_key: str = "table",
+                 ids_key: str = "ids", slots_key: str = "slots"):
+        self.kv = kv
+        self.table_key = table_key
+        self.ids_key = ids_key
+        self.slots_key = slots_key
+
+    def state_dict(self) -> dict:
+        """Mapper + host-tier state; the Trainer writes this to a
+        sidecar at every checkpoint save and restores it on resume so
+        the restored table leaf meets the slot map it was trained
+        with."""
+        return self.kv.state_dict()
+
+    def load_state_dict(self, state: dict):
+        self.kv.load_state_dict(state)
+
+    def _aux_leaf_indices(self, opt_state):
+        """Indices (into the flattened opt_state) of leaves that are
+        row-aligned with the table: path contains the table key and the
+        leading dim equals the device capacity."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+        idx = []
+        for i, (path, leaf) in enumerate(flat):
+            shape = getattr(leaf, "shape", None)
+            if not shape or shape[0] != self.kv.capacity:
+                continue
+            if any(
+                getattr(k, "key", getattr(k, "name", None))
+                == self.table_key
+                for k in path
+            ):
+                idx.append(i)
+        return [leaf for _, leaf in flat], treedef, idx
+
+    def __call__(self, state, batch, count: bool = True):
+        """``count=False`` (the Trainer's eval path) serves the batch
+        without recording frequency uses — eval traffic must not skew
+        the LFU placement/eviction statistics."""
+        if self.ids_key not in batch:
+            return state, batch
+        batch = dict(batch)
+        raw = batch.pop(self.ids_key)
+        leaves, treedef, aux_idx = self._aux_leaf_indices(
+            state.opt_state
+        )
+        replace = {}
+        if aux_idx:
+            table, slots, aux_new = self.kv.prepare_batch(
+                state.params[self.table_key], np.asarray(raw),
+                count=count, aux=[leaves[i] for i in aux_idx],
+            )
+            for i, new in zip(aux_idx, aux_new):
+                leaves[i] = new
+            replace["opt_state"] = jax.tree_util.tree_unflatten(
+                treedef, leaves
+            )
+        else:
+            table, slots = self.kv.prepare_batch(
+                state.params[self.table_key], np.asarray(raw),
+                count=count,
+            )
+        batch[self.slots_key] = jnp.asarray(slots)
+        params = dict(state.params)
+        params[self.table_key] = table
+        return dataclasses.replace(
+            state, params=params, **replace
+        ), batch

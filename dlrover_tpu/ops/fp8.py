@@ -72,6 +72,37 @@ def quant_autocast(mode: str = "fp8"):
         _Flag.mode = prev
 
 
+class _RematFlag:
+    disabled = False
+
+
+def remat_disabled() -> bool:
+    """Whether the strategy asked for NO rematerialisation (trace-time).
+
+    Set by auto_accelerate for ``Strategy.remat="none"`` via
+    :func:`no_remat_autocast`. Consumers: the per-layer scan
+    (parallel/pipeline.py ``stage_layer_scan``) skips its
+    ``jax.checkpoint`` wrap, and ops/quantization.py skips the
+    ``checkpoint_name`` residual tags — so a no-remat step carries no
+    checkpoint custom-call and saves no quantized-dot residuals
+    (measured: a stray ``checkpoint.*`` custom-call charged ~7% of the
+    headline step under remat=none before this gate)."""
+    return _RematFlag.disabled
+
+
+@contextlib.contextmanager
+def no_remat_autocast():
+    """Trace-time switch: model-level remat and checkpoint_name tagging
+    are suppressed while this is active (the loss trace of a
+    ``Strategy.remat="none"`` step)."""
+    prev = _RematFlag.disabled
+    _RematFlag.disabled = True
+    try:
+        yield
+    finally:
+        _RematFlag.disabled = prev
+
+
 @contextlib.contextmanager
 def _quant_disabled():
     """Force-disable quantization inside an active autocast region."""

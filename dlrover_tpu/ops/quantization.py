@@ -225,6 +225,12 @@ def _name_qdot_out(out):
     quantize+matmul chain under per-layer remat."""
     from jax.ad_checkpoint import checkpoint_name
 
+    from dlrover_tpu.ops.fp8 import remat_disabled
+
+    if remat_disabled():
+        # remat="none": no checkpoint wraps the trace, so the tag would
+        # only leave a stray name custom-call in the compiled step
+        return out
     return checkpoint_name(out, "qdot_out")
 
 
@@ -238,6 +244,12 @@ def _name_qdot_res(qa, sa, qb, sb):
     deal the quantized residual design was chosen for."""
     from jax.ad_checkpoint import checkpoint_name
 
+    from dlrover_tpu.ops.fp8 import remat_disabled
+
+    if remat_disabled():
+        # no-remat trace: custom_vjp residuals are stored directly, a
+        # save-policy tag has nothing to gate and must not lower
+        return qa, sa, qb, sb
     return (checkpoint_name(qa, "qdot_res"), checkpoint_name(sa, "qdot_res"),
             checkpoint_name(qb, "qdot_res"), checkpoint_name(sb, "qdot_res"))
 

@@ -296,11 +296,19 @@ def auto_accelerate(
     def microbatch_grads(params, batch, rng):
         import contextlib
 
-        from dlrover_tpu.ops.fp8 import quant_autocast
+        from dlrover_tpu.ops.fp8 import no_remat_autocast, quant_autocast
 
         cparams = _compute_cast(params, cast_dtype)
         ctx = quant_autocast(quant) if quant else contextlib.nullcontext()
-        with ctx:
+        # remat="none" means NONE: suppress the model's own per-layer
+        # jax.checkpoint and the qdot residual name-tags at trace time —
+        # otherwise a no-remat headline still pays a checkpoint
+        # custom-call for quantized dot residuals (measured ~7% of step)
+        rctx = (
+            no_remat_autocast() if strategy.remat == "none"
+            else contextlib.nullcontext()
+        )
+        with ctx, rctx:
             if has_aux:
                 grad_fn = jax.value_and_grad(inner_loss, has_aux=True)
                 (loss, aux), grads = grad_fn(cparams, batch, rng)

@@ -1186,10 +1186,14 @@ def stage_layer_scan(
         return (out, aux_sum + aux), None
 
     def stage_fn(local_params, h, *extras):
+        from dlrover_tpu.ops.fp8 import remat_disabled
+
         def scan_body(carry, layer_params):
             return body(carry, layer_params, *extras)
 
-        if remat:
+        # the strategy's remat="none" wins over the model config: a
+        # no-remat trace must emit no checkpoint at any layer
+        if remat and not remat_disabled():
             scan_body = jax.checkpoint(
                 scan_body,
                 policy=quant_aware_policy(

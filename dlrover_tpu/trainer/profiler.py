@@ -55,12 +55,19 @@ class StepProfiler:
     profiling steps [start, start+num) once warmup is done)."""
 
     def __init__(self, log_dir: str, start_step: int = 10,
-                 num_steps: int = 3, publish_top_ops: bool = False):
+                 num_steps: int = 3, publish_top_ops: bool = False,
+                 forbid_ops: tuple = ()):
         self.log_dir = log_dir
         self.start_step = int(start_step)
         self.stop_step = int(start_step) + int(num_steps)
         self.num_steps = int(num_steps)
         self.publish_top_ops = publish_top_ops
+        # op-name substrings that must NOT appear in the captured
+        # window (case-insensitive) — e.g. ("checkpoint",) under
+        # Strategy.remat="none", where any checkpoint custom-call means
+        # a remat gate leaked. Checked in maybe_stop; raises
+        # AssertionError listing the offenders.
+        self.forbid_ops = tuple(forbid_ops)
         self._active = False
         self._done = False
 
@@ -106,6 +113,28 @@ class StepProfiler:
             except Exception:  # noqa: BLE001 - stats are best-effort
                 logger.warning("per-op stats publish failed",
                                exc_info=True)
+        if self.forbid_ops:
+            self.assert_ops_absent(self.forbid_ops)
+
+    def assert_ops_absent(self, substrings: tuple) -> int:
+        """Raise AssertionError if any profiled HLO op name contains one
+        of ``substrings``. Vacuously passes when the trace yields no op
+        stats (xprof unavailable) — the gate is a TPU-profile check, not
+        a CPU-smoke one; returns the number of ops inspected so callers
+        can tell "verified clean" from "nothing to check". Raises
+        explicitly (not via the ``assert`` statement, which ``-O``
+        strips)."""
+        ops = top_ops_from_trace(self.log_dir, k=4096)
+        bad = [
+            o for o in ops
+            if any(s.lower() in o["op"].lower() for s in substrings)
+        ]
+        if bad:
+            raise AssertionError(
+                f"forbidden ops in profile window {self.log_dir}: "
+                f"{[(o['op'], o['category']) for o in bad]}"
+            )
+        return len(ops)
 
     def close(self):
         if self._active:

@@ -78,6 +78,17 @@ class Trainer:
     ``train_data``: an iterable of host batches (re-iterable for multi-
     epoch), e.g. an :class:`~dlrover_tpu.trainer.elastic.ElasticDataLoader`.
     Each batch feeds ``loss_fn(params, batch, rng)``.
+
+    ``prestep``: optional host-side hook ``(state, batch) -> (state,
+    batch)`` run before every jitted step — the integration point for
+    dynamic-embedding batch preparation (e.g.
+    :class:`~dlrover_tpu.models.recsys.TieredBatchPreparer`, which
+    promotes/demotes TieredKvEmbedding rows so the compiled step only
+    ever sees device-resident slots). It runs for eval batches too. A
+    hook exposing ``state_dict``/``load_state_dict`` is checkpointed in
+    a sidecar next to the engine checkpoint and restored on resume —
+    without it a restarted job would pair the restored table with an
+    empty id -> slot mapper and silently scramble the embeddings.
     """
 
     def __init__(
@@ -90,6 +101,7 @@ class Trainer:
         eval_data: Optional[Iterable] = None,
         eval_fn: Optional[Callable] = None,
         optimizer=None,
+        prestep: Optional[Callable] = None,
     ):
         self.args = args
         self.loss_fn = loss_fn
@@ -98,6 +110,17 @@ class Trainer:
         self.train_data = train_data
         self.eval_data = eval_data
         self.eval_fn = eval_fn or loss_fn
+        self.prestep = prestep
+        self._prestep_accepts_count = False
+        if prestep is not None:
+            import inspect
+
+            try:
+                self._prestep_accepts_count = (
+                    "count" in inspect.signature(prestep).parameters
+                )
+            except (TypeError, ValueError):
+                pass
         self.optimizer = optimizer or _build_optimizer(args)
         strategy = args.strategy or Strategy()
         overrides = dict(
@@ -236,6 +259,7 @@ class Trainer:
         else:
             self.state = tree
         self.global_step = int(step)
+        self._restore_prestep_state()
         logger.info("resumed from checkpoint step %s", step)
         return self.global_step
 
@@ -275,6 +299,8 @@ class Trainer:
                 rng = jax.random.fold_in(
                     jax.random.key(args.seed), self.global_step
                 )
+                if self.prestep is not None:
+                    self.state, batch = self.prestep(self.state, batch)
                 self.state, metrics = self._accel.train_step(
                     self.state, batch, rng
                 )
@@ -325,8 +351,102 @@ class Trainer:
             return False
         tree = self._ckpt_tree()
         if persist:
-            return self._engine.save_to_storage(self.global_step, tree)
-        return self._engine.save_to_memory(self.global_step, tree)
+            ok = self._engine.save_to_storage(self.global_step, tree)
+        else:
+            ok = self._engine.save_to_memory(self.global_step, tree)
+        if ok:
+            self._save_prestep_state(persist)
+        return ok
+
+    # two sidecars: the latest (memory-cadence) save and the latest
+    # PERSISTED save — a restore can land on either source (shm vs
+    # storage), and the mapper must pair with the exact table step it
+    # was saved with; a mismatched pair silently scrambles embeddings
+    _PRESTEP_FILES = ("prestep_state.npy", "prestep_state_persist.npy")
+
+    def _prestep_stateful(self) -> bool:
+        """Save and restore must gate on the SAME capability check — a
+        hook with only one of the pair would otherwise write sidecars
+        it can't load, or demand sidecars that were never written."""
+        return hasattr(self.prestep, "state_dict") and hasattr(
+            self.prestep, "load_state_dict"
+        )
+
+    def _save_prestep_state(self, persist: bool):
+        """Sidecar for stateful prestep hooks (e.g. a tiered embedding's
+        id -> slot mapper + host rows): variable-sized host arrays can't
+        ride the engine's shape-matched tree, so they are written next
+        to the checkpoint at every save, tagged with the step so resume
+        can refuse a mismatched pair. Runs at memory-save cadence
+        because shm is the preferred restore source — with a very large
+        host tier, raise ``save_steps`` to bound the sidecar I/O."""
+        if not self._prestep_stateful():
+            return
+        import numpy as np
+
+        os.makedirs(self.args.output_dir, exist_ok=True)
+        payload = np.array(
+            {"step": self.global_step,
+             "state": self.prestep.state_dict()},
+            dtype=object,
+        )
+        latest = os.path.join(
+            self.args.output_dir, self._PRESTEP_FILES[0]
+        )
+        tmp = latest + ".tmp"
+        with open(tmp, "wb") as f:  # np.save(str) appends .npy
+            np.save(f, payload, allow_pickle=True)
+        os.replace(tmp, latest)
+        if persist:
+            # snapshot by hard-link (fall back to copy): the persist
+            # file keeps this inode when the latest file is later
+            # replaced — no second serialization of the host tier
+            dst = os.path.join(
+                self.args.output_dir, self._PRESTEP_FILES[1]
+            )
+            try:
+                os.link(latest, tmp)
+            except OSError:
+                import shutil
+
+                shutil.copyfile(latest, tmp)
+            os.replace(tmp, dst)
+
+    def _restore_prestep_state(self):
+        """Load the sidecar whose step matches the restored checkpoint
+        exactly. No match = the mapper would pair with a table from a
+        different step (silently wrong embeddings), so refuse unless
+        DLROVER_TPU_IGNORE_CKPT opts into starting from empty state."""
+        if not self._prestep_stateful():
+            return
+        import numpy as np
+
+        seen_steps = []
+        for name in self._PRESTEP_FILES:
+            path = os.path.join(self.args.output_dir, name)
+            if not os.path.exists(path):
+                continue
+            payload = np.load(path, allow_pickle=True).item()
+            if int(payload["step"]) == self.global_step:
+                self.prestep.load_state_dict(payload["state"])
+                return
+            seen_steps.append(int(payload["step"]))
+        if os.environ.get("DLROVER_TPU_IGNORE_CKPT"):
+            logger.warning(
+                "no prestep sidecar matches restored step %s (found "
+                "steps %s); starting the prestep hook from empty state "
+                "(DLROVER_TPU_IGNORE_CKPT set)",
+                self.global_step, seen_steps,
+            )
+            return
+        raise ValueError(
+            f"checkpoint restored step {self.global_step} but the "
+            f"prestep sidecar(s) in {self.args.output_dir} hold steps "
+            f"{seen_steps}: loading a mismatched id->slot map would "
+            f"silently corrupt the restored embedding table. Delete "
+            f"the checkpoint dir or set DLROVER_TPU_IGNORE_CKPT=1 to "
+            f"start the prestep hook from empty state."
+        )
 
     # ---------------------------------------------------------------- eval
 
@@ -345,6 +465,18 @@ class Trainer:
             self._eval_step = eval_step
         losses = []
         for batch in self.eval_data:
+            # eval batches need the same host-side preparation as train
+            # ones (raw ids -> device-resident slots); the table update
+            # it threads back only changes row PLACEMENT, not values.
+            # count=False where supported: eval traffic must not
+            # inflate the frequency stats that drive demotion/eviction
+            if self.prestep is not None:
+                if self._prestep_accepts_count:
+                    self.state, batch = self.prestep(
+                        self.state, batch, count=False
+                    )
+                else:
+                    self.state, batch = self.prestep(self.state, batch)
             losses.append(eval_step(self.state.params, batch))
         loss = float(jnp.mean(jnp.stack(losses))) if losses else float(
             "nan"

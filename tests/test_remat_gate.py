@@ -1,0 +1,174 @@
+"""The remat="none" trace-time gate.
+
+Strategy.remat="none" must mean NONE: the model's own per-layer
+``jax.checkpoint`` (and the qdot residual ``checkpoint_name`` tags the
+quant-aware policy would consume) must vanish from the traced step —
+before the gate, a leaked checkpoint custom-call charged ~7% of the
+remat=none headline step (BENCH_r05 top_ops ``checkpoint.10``,
+25.7 ms). Intentional non-remat checkpoints — the fused CE's
+logits-memory chunking — survive the gate untouched.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import PRESETS, llama_init, llama_loss_fn
+from dlrover_tpu.ops.fp8 import no_remat_autocast, quant_autocast
+
+CHECKPOINT_PRIMS = ("remat2", "checkpoint")
+NAME_PRIMS = ("name",)
+
+
+def _count_eqns(jaxpr, prim_names) -> int:
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in prim_names:
+            total += 1
+        for val in eqn.params.values():
+            for sub in _subjaxprs(val):
+                total += _count_eqns(sub, prim_names)
+    return total
+
+
+def _subjaxprs(val):
+    if hasattr(val, "jaxpr"):  # ClosedJaxpr
+        yield val.jaxpr
+    elif hasattr(val, "eqns"):  # Jaxpr
+        yield val
+    elif isinstance(val, (tuple, list)):
+        for v in val:
+            yield from _subjaxprs(v)
+
+
+def _traced_loss(cfg, ctx_factories):
+    loss_fn = llama_loss_fn(cfg)
+    params = llama_init(cfg, jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 17))
+    )
+
+    def run(p, b):
+        return loss_fn(p, b, jax.random.key(0))
+
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        for f in ctx_factories:
+            stack.enter_context(f())
+        return jax.make_jaxpr(jax.grad(run))(
+            params, {"tokens": tokens}
+        ).jaxpr
+
+
+class TestNoRematGate:
+    def _cfg(self, **kw):
+        return dataclasses.replace(PRESETS["tiny"], **kw)
+
+    def test_model_checkpoint_stripped_under_gate(self):
+        cfg = self._cfg(remat=True, ce_chunks=1)
+        before = _count_eqns(_traced_loss(cfg, []), CHECKPOINT_PRIMS)
+        assert before >= 1  # config.remat=True checkpoints the scan body
+        after = _count_eqns(
+            _traced_loss(cfg, [no_remat_autocast]), CHECKPOINT_PRIMS
+        )
+        assert after == 0
+
+    def test_qdot_residual_tags_stripped_under_gate(self):
+        cfg = self._cfg(remat=True, ce_chunks=1)
+        tagged = _count_eqns(
+            _traced_loss(cfg, [lambda: quant_autocast("int8")]),
+            NAME_PRIMS,
+        )
+        assert tagged >= 1  # qdot_out/qdot_res tags for the save policy
+        untagged = _count_eqns(
+            _traced_loss(
+                cfg,
+                [lambda: quant_autocast("int8"), no_remat_autocast],
+            ),
+            NAME_PRIMS,
+        )
+        assert untagged == 0
+
+    def test_ce_chunk_checkpoint_survives_gate(self):
+        """ce_chunks>1 is a logits-memory feature, not remat policy —
+        its single jax.checkpoint must NOT be stripped."""
+        cfg = self._cfg(remat=False, ce_chunks=2)
+        n = _count_eqns(
+            _traced_loss(cfg, [no_remat_autocast]), CHECKPOINT_PRIMS
+        )
+        assert n == 1
+
+    def test_strategy_none_sets_gate_in_accelerate(self):
+        """End-to-end: auto_accelerate with remat='none' produces a step
+        whose compiled loss saw the gate (counted via the model path
+        running checkpoint-free)."""
+        import optax
+
+        from dlrover_tpu.models import llama_logical_axes
+        from dlrover_tpu.parallel import (
+            MeshConfig,
+            Strategy,
+            auto_accelerate,
+        )
+
+        cfg = self._cfg(remat=True, ce_chunks=1)
+        res = auto_accelerate(
+            llama_loss_fn(cfg),
+            lambda rng: llama_init(cfg, rng),
+            optax.sgd(1e-3),
+            llama_logical_axes(cfg),
+            strategy=Strategy(
+                mesh=MeshConfig(data=1, fsdp=1), remat="none"
+            ),
+            devices=jax.devices()[:1],
+        )
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 17))
+        )
+        state, m = res.train_step(
+            res.state, {"tokens": tokens}, jax.random.key(0)
+        )
+        assert np.isfinite(float(m["loss"]))
+
+
+class TestProfilerForbidOps:
+    def test_assert_ops_absent_raises_on_match(self, tmp_path,
+                                               monkeypatch):
+        from dlrover_tpu.trainer import profiler as prof_mod
+
+        monkeypatch.setattr(
+            prof_mod, "top_ops_from_trace",
+            lambda log_dir, k=15, steps=1: [
+                {"op": "fusion.1", "category": "fusion",
+                 "self_ms_per_step": 1.0},
+                {"op": "checkpoint.10", "category": "custom-call",
+                 "self_ms_per_step": 25.7},
+            ],
+        )
+        p = prof_mod.StepProfiler(str(tmp_path))
+        with pytest.raises(AssertionError, match="checkpoint.10"):
+            p.assert_ops_absent(("checkpoint",))
+        p.assert_ops_absent(("somethingelse",))
+
+    def test_forbid_ops_checked_at_window_stop(self, tmp_path,
+                                               monkeypatch):
+        from dlrover_tpu.trainer import profiler as prof_mod
+
+        monkeypatch.setattr(
+            prof_mod, "top_ops_from_trace",
+            lambda log_dir, k=15, steps=1: [
+                {"op": "checkpoint.3", "category": "custom-call",
+                 "self_ms_per_step": 1.0},
+            ],
+        )
+        p = prof_mod.StepProfiler(
+            str(tmp_path), start_step=0, num_steps=1,
+            forbid_ops=("checkpoint",),
+        )
+        p.maybe_start(0)
+        with pytest.raises(AssertionError):
+            p.maybe_stop(0)
