@@ -20,6 +20,7 @@ Meta: {"step": int, "paths": [leaf names], "leaves": [LeafMeta], ...}
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import queue as _queue
@@ -28,6 +29,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from dlrover_tpu.common.chaos import chaos_transform
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.ipc import (
     SharedLock,
@@ -227,15 +229,31 @@ def host_shard_filename(host_rank: int) -> str:
     return f"host_{host_rank}.dlck"
 
 
-def write_host_shard(storage, path: str, meta: CheckpointMeta, data) -> None:
+def manifest_filename(host_rank: int) -> str:
+    return f"host_{host_rank}.manifest.json"
+
+
+def write_host_shard(
+    storage, path: str, meta: CheckpointMeta, data
+) -> tuple[int, int]:
     """Stream header + meta + payload; ``data`` may be a memoryview into
     shm — never copy the (multi-GB) payload into an intermediate blob.
 
     The payload CRC (native libdlrtpu crc32, zlib fallback) is stamped
-    into the meta so restores detect torn or bit-rotted shard files."""
+    into the meta so restores detect torn or bit-rotted shard files.
+    Returns (payload_crc, payload_nbytes) — the INTENDED values, stamped
+    into the sidecar manifest before any fault (chaos tear/bitflip, a
+    real crash mid-write) can corrupt the on-disk bytes."""
     from dlrover_tpu import native as dlrtpu_native
 
     meta.payload_crc = dlrtpu_native.crc32(data)
+    payload_nbytes = (
+        data.nbytes if isinstance(data, memoryview) else len(data)
+    )
+    # fault site: tear (truncate mid-shard) or bit-flip the persisted
+    # payload AFTER the crc was computed — exactly what a preemption or
+    # bit-rot does to a real file
+    data = chaos_transform("ckpt.write", data, step=meta.step, path=path)
     meta_bytes = pickle.dumps(meta)
     storage.write_parts(
         [
@@ -245,6 +263,156 @@ def write_host_shard(storage, path: str, meta: CheckpointMeta, data) -> None:
         ],
         path,
     )
+    return meta.payload_crc, payload_nbytes
+
+
+def write_shard_manifest(
+    storage, step_dir: str, shard_id: int, step: int,
+    payload_crc: int, payload_nbytes: int, engine: str,
+) -> None:
+    """Per-shard checksum manifest, written right after its shard and
+    strictly BEFORE the atomic step-dir rename / tracker update, so a
+    restore can verify integrity without trusting the shard's own
+    (possibly torn) embedded meta."""
+    entry = {
+        "format": 1,
+        "step": step,
+        "file": host_shard_filename(shard_id),
+        "payload_crc": payload_crc,
+        "payload_nbytes": payload_nbytes,
+        "engine": engine,
+    }
+    blob = json.dumps(entry, sort_keys=True).encode()
+    blob = chaos_transform("ckpt.manifest", blob, step=step)
+    storage.write(blob, os.path.join(step_dir, manifest_filename(shard_id)))
+
+
+def _file_payload_crc(path: str, payload_start: int) -> tuple[int, int]:
+    """(crc32, nbytes) of the payload region, chunked (bounded memory)."""
+    from dlrover_tpu import native as dlrtpu_native
+
+    crc = 0
+    nbytes = 0
+    with open(path, "rb") as f:
+        f.seek(payload_start)
+        while True:
+            chunk = f.read(8 << 20)
+            if not chunk:
+                break
+            crc = dlrtpu_native.crc32(chunk, crc)
+            nbytes += len(chunk)
+    return crc, nbytes
+
+
+_VERIFIED_MARKER = ".verified"
+
+
+def verify_step_dir(step_dir: str, deep: bool = True) -> tuple[bool, str]:
+    """Integrity-verify every shard of a persisted step directory.
+
+    Returns (ok, reason). A shard verifies against its sidecar manifest
+    (payload size + crc recomputed from the actual bytes); a legacy
+    shard without a manifest falls back to the crc embedded in its own
+    meta. Any torn, bit-flipped, unreadable, or manifest-corrupted
+    shard fails the WHOLE directory — restore then falls back to the
+    next-newest verified checkpoint instead of loading garbage.
+
+    ``deep=False`` runs structural + size checks only (catches torn
+    writes, unreadable metas, corrupt manifests) and skips the payload
+    CRC: for the EAGER load path, whose ``read_host_shard`` re-verifies
+    every payload's embedded crc anyway — a deep verify there would
+    read and checksum multi-GB payloads twice. The targeted shard-wise
+    path performs crc-less slice reads, so it must verify deep.
+
+    Deep CRC results are cached in a ``.verified`` marker inside the
+    step dir (shard files are immutable once committed): the first
+    verifier pays the full read; later ones — other hosts of a shared
+    filesystem, repeat restores — only size-check, so an 8-host restore
+    does not read the whole checkpoint 8 times over. Trade: bit-rot
+    striking AFTER a successful deep verify (same size) is not
+    re-detected through the cache."""
+    if not os.path.isdir(step_dir):
+        return False, "not a directory"
+    try:
+        names = sorted(os.listdir(step_dir))
+    except OSError as e:
+        return False, f"unreadable: {e}"
+    shards = [n for n in names if n.endswith(".dlck")]
+    if not shards:
+        return False, "no shard files"
+    marker_path = os.path.join(step_dir, _VERIFIED_MARKER)
+    try:
+        with open(marker_path) as f:
+            already_verified = json.load(f).get("files", {})
+    except Exception:  # noqa: BLE001 - absent or corrupt cache: re-crc
+        already_verified = {}
+    newly_verified = {}
+    for fname in shards:
+        fpath = os.path.join(step_dir, fname)
+        mpath = os.path.join(step_dir, fname[: -len(".dlck")] +
+                             ".manifest.json")
+        header = read_host_shard_meta(fpath)
+        if header is None:
+            return False, f"{fname}: missing or unreadable shard"
+        meta, payload_start = header
+        if os.path.exists(mpath):
+            try:
+                with open(mpath) as f:
+                    manifest = json.load(f)
+                want_crc = int(manifest["payload_crc"])
+                want_nbytes = int(manifest["payload_nbytes"])
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                return False, f"{fname}: corrupted manifest ({e})"
+        else:
+            # legacy checkpoint (pre-manifest): the crc embedded in the
+            # shard's own meta is the only integrity signal; pre-crc
+            # shards (payload_crc < 0) still get the SIZE check below —
+            # a torn legacy shard must fail verify, not crash the
+            # loader's np.frombuffer
+            want_crc = (
+                meta.payload_crc if meta.payload_crc >= 0 else None
+            )
+            want_nbytes = meta.total_bytes
+        try:
+            actual_nbytes = os.path.getsize(fpath) - payload_start
+        except OSError as e:
+            return False, f"{fname}: unreadable ({e})"
+        if actual_nbytes != want_nbytes:
+            return False, (
+                f"{fname}: torn payload ({actual_nbytes} bytes, "
+                f"expected {want_nbytes})"
+            )
+        if not deep or want_crc is None:
+            continue  # size-verified; no (or loader-side) payload crc
+        if already_verified.get(fname) == want_nbytes:
+            continue  # full crc already paid by a previous verifier
+        try:
+            got_crc, got_nbytes = _file_payload_crc(fpath, payload_start)
+        except OSError as e:
+            return False, f"{fname}: unreadable payload ({e})"
+        if got_nbytes != want_nbytes:
+            return False, (
+                f"{fname}: torn payload ({got_nbytes} bytes, expected "
+                f"{want_nbytes})"
+            )
+        if got_crc != want_crc:
+            return False, (
+                f"{fname}: checksum mismatch (want {want_crc:08x} got "
+                f"{got_crc:08x})"
+            )
+        newly_verified[fname] = want_nbytes
+    if newly_verified:
+        # best-effort cache write (atomic rename); read-only storage
+        # just means every verifier pays the full crc
+        try:
+            already_verified.update(newly_verified)
+            tmp = marker_path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"files": already_verified}, f)
+            os.replace(tmp, marker_path)
+        except OSError:
+            pass
+    return True, ""
 
 
 def read_host_shard_meta(
@@ -261,19 +429,27 @@ def read_host_shard_meta(
     """
     if not os.path.exists(path):
         return None
-    with open(path, "rb") as f:
-        meta_len = int.from_bytes(f.read(_META_LEN_SIZE), "little")
-        meta = pickle.loads(f.read(meta_len))
+    try:
+        with open(path, "rb") as f:
+            meta_len = int.from_bytes(f.read(_META_LEN_SIZE), "little")
+            meta = pickle.loads(f.read(meta_len))
+    except Exception:  # noqa: BLE001 - torn header/meta region
+        logger.error("unreadable shard meta in %s; rejecting", path)
+        return None
     return meta, _META_LEN_SIZE + meta_len
 
 
 def read_host_shard(path: str) -> tuple[CheckpointMeta, bytes] | None:
     if not os.path.exists(path):
         return None
-    with open(path, "rb") as f:
-        meta_len = int.from_bytes(f.read(_META_LEN_SIZE), "little")
-        meta = pickle.loads(f.read(meta_len))
-        data = f.read(meta.total_bytes)
+    try:
+        with open(path, "rb") as f:
+            meta_len = int.from_bytes(f.read(_META_LEN_SIZE), "little")
+            meta = pickle.loads(f.read(meta_len))
+            data = f.read(meta.total_bytes)
+    except Exception:  # noqa: BLE001 - torn header/meta region
+        logger.error("unreadable shard meta in %s; rejecting", path)
+        return None
     if meta.payload_crc >= 0:
         from dlrover_tpu import native as dlrtpu_native
 
@@ -603,7 +779,16 @@ class AsyncCheckpointSaver:
     def _save_shard(self, step_dir, meta, data, local_rank):
         shard_id = self.host_rank * self.local_shard_num + local_rank
         path = os.path.join(step_dir, host_shard_filename(shard_id))
-        write_host_shard(self._storage, path, meta, data)
+        crc, payload_nbytes = write_host_shard(
+            self._storage, path, meta, data
+        )
+        # manifest lands before the .done marker, the atomic rename and
+        # the tracker update: nothing can advertise this shard until its
+        # integrity record exists
+        write_shard_manifest(
+            self._storage, step_dir, shard_id, meta.step,
+            crc, payload_nbytes, meta.engine,
+        )
 
     def _commit_checkpoint(
         self, step_dir: str, step: int, local_rank, engine: str = "sharded"

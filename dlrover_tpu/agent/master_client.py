@@ -46,10 +46,12 @@ class MasterClient:
     def node_id(self) -> int:
         return self._node_id
 
-    def _get(self, message, retries: int = 5):
+    def _get(self, message, retries: int | None = None):
+        # retries=None -> the shared RetryPolicy decides (DLROVER_RPC_*
+        # env, one place); explicit retries = fail-fast best-effort calls
         return self._rpc.get(self._node_type, self._node_id, message, retries)
 
-    def _report(self, message, retries: int = 5) -> bool:
+    def _report(self, message, retries: int | None = None) -> bool:
         return self._rpc.report(
             self._node_type, self._node_id, message, retries
         )
@@ -237,7 +239,9 @@ class MasterClient:
         ))
 
     def get_paral_config(self) -> msg.ParallelConfig:
-        return self._get(msg.ParallelConfigRequest())
+        # best-effort tuning poll: fail fast and let the tuner's
+        # NonCriticalGuard degrade, like the stats reports above
+        return self._get(msg.ParallelConfigRequest(), retries=2)
 
     def report_elastic_run_config(self, configs: dict) -> bool:
         return self._report(msg.ElasticRunConfig(configs=configs))

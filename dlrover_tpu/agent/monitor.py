@@ -15,6 +15,7 @@ import time
 
 from dlrover_tpu.common.constants import ConfigPath, JobConstant
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.retry import NonCriticalGuard
 
 logger = get_logger(__name__)
 
@@ -59,11 +60,24 @@ def get_tpu_stats() -> list:
 class ResourceMonitor:
     """Periodically reports host CPU/mem (+ TPU stats) to the master."""
 
+    # Stats are best-effort, but a healed partition must bring them
+    # back: the guard is a circuit breaker (many misses to trip, then
+    # periodic half-open probes), never a permanent off-switch —
+    # permanently silent step/resource reports could later be misread
+    # by the master as a job-wide hang.
+    _MAX_MISSES = 20
+    _COOLDOWN = 300.0
+
     def __init__(self, master_client, interval=JobConstant.MONITOR_INTERVAL):
         self._client = master_client
         self._interval = interval
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
+        self._guard = NonCriticalGuard(
+            "resource-monitor",
+            max_consecutive_failures=self._MAX_MISSES,
+            cooldown=self._COOLDOWN,
+        )
         self.report_tpu = False
 
     def start(self):
@@ -78,10 +92,12 @@ class ResourceMonitor:
     def _loop(self):
         while not self._stopped.is_set():
             try:
-                self._client.report_used_resource(
-                    get_process_cpu_percent(),
-                    get_used_memory_mb(),
-                    get_tpu_stats() if self.report_tpu else [],
+                self._guard.run(
+                    lambda: self._client.report_used_resource(
+                        get_process_cpu_percent(),
+                        get_used_memory_mb(),
+                        get_tpu_stats() if self.report_tpu else [],
+                    )
                 )
             except Exception:  # noqa: BLE001
                 pass
@@ -123,11 +139,20 @@ class TrainingMetricsReporter:
     """Relays per-step metrics a worker writes to the runtime-metrics
     file up to the master (global step -> speed monitor)."""
 
+    # circuit breaker, not a kill switch: see ResourceMonitor
+    _MAX_MISSES = 20
+    _COOLDOWN = 300.0
+
     def __init__(self, master_client, interval=JobConstant.MONITOR_INTERVAL):
         self._client = master_client
         self._interval = interval
         self._stopped = threading.Event()
         self._last_step = -1
+        self._guard = NonCriticalGuard(
+            "metrics-reporter",
+            max_consecutive_failures=self._MAX_MISSES,
+            cooldown=self._COOLDOWN,
+        )
         self._path = os.environ.get(
             ConfigPath.ENV_RUNTIME_METRICS, ConfigPath.RUNTIME_METRICS
         )
@@ -140,18 +165,22 @@ class TrainingMetricsReporter:
     def stop(self):
         self._stopped.set()
 
+    def _report_once(self):
+        if not os.path.exists(self._path):
+            return
+        with open(self._path) as f:
+            metrics = json.load(f)
+        step = int(metrics.get("step", -1))
+        if step > self._last_step:
+            self._client.report_global_step(
+                step, metrics.get("timestamp", time.time())
+            )
+            self._last_step = step
+
     def _loop(self):
         while not self._stopped.is_set():
             try:
-                if os.path.exists(self._path):
-                    with open(self._path) as f:
-                        metrics = json.load(f)
-                    step = int(metrics.get("step", -1))
-                    if step > self._last_step:
-                        self._client.report_global_step(
-                            step, metrics.get("timestamp", time.time())
-                        )
-                        self._last_step = step
+                self._guard.run(self._report_once)
             except Exception:  # noqa: BLE001
                 pass
             self._stopped.wait(self._interval)

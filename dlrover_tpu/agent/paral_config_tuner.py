@@ -17,6 +17,7 @@ import threading
 
 from dlrover_tpu.common.constants import ConfigPath
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.retry import NonCriticalGuard
 from dlrover_tpu.agent.master_client import MasterClient
 
 logger = get_logger(__name__)
@@ -34,8 +35,16 @@ class ParalConfigTuner:
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
         self._last_written: str = ""
+        # tuning is best-effort: exhausted retry budgets degrade the
+        # tuner to off (the trainer keeps its last config) rather than
+        # hammering a dead master forever
+        self._guard = NonCriticalGuard("paral-config-tuner")
         # export the path so worker processes spawned later inherit it
         os.environ[ConfigPath.ENV_PARAL_CONFIG] = self._config_path
+
+    @property
+    def degraded(self) -> bool:
+        return self._guard.disabled
 
     def start(self):
         if self._thread is not None:
@@ -54,13 +63,18 @@ class ParalConfigTuner:
                 self.tune_once()
             except Exception:  # noqa: BLE001
                 logger.exception("paral-config poll failed")
+            if self._guard.disabled:
+                logger.warning(
+                    "paral-config tuner degraded; stopping the poll loop"
+                )
+                return
             self._stopped.wait(self._interval)
 
     def tune_once(self) -> bool:
         """One poll+write cycle; returns True if the file was (re)written."""
         if self._client is None:
             return False
-        config = self._client.get_paral_config()
+        config = self._guard.run(self._client.get_paral_config)
         if config is None:
             return False
         payload = json.dumps(dataclasses.asdict(config), sort_keys=True)

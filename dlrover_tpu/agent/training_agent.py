@@ -25,6 +25,7 @@ import sys
 import time
 
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.agent.monitor import (
     HeartbeatReporter,
     ResourceMonitor,
@@ -138,11 +139,19 @@ class MasterRendezvousHandler:
 
     def next_rendezvous(self):
         """Returns (round, world, rank_offset, total_world, coordinator)."""
-        self._client.join_rendezvous(
+        joined = self._client.join_rendezvous(
             self._node_rank, self._local_world_size, self._name
         )
         start = time.time()
         while True:
+            if not joined:
+                # the master acked False (its join handler faulted —
+                # e.g. an injected rdzv.join drop): the node was never
+                # recorded as waiting, so re-send the join or this node
+                # polls an empty world until the timeout
+                joined = self._client.join_rendezvous(
+                    self._node_rank, self._local_world_size, self._name
+                )
             world = self._client.get_comm_world(self._name, self._node_rank)
             if world and world.world and self._node_rank in world.world:
                 break
@@ -159,6 +168,18 @@ class MasterRendezvousHandler:
                 break
             rank_offset += world.world[r]
         total = sum(world.world.values())
+        # Rendezvous can block for the whole elastic-wait window; reset
+        # stall clocks in THIS process so the wait is not read as a
+        # hang. Scope note: detectors live per-process, so this covers
+        # in-process/standalone trainers that drive a rendezvous
+        # handler directly; subprocess workers are restarted after a
+        # rendezvous and start with fresh clocks anyway (and their
+        # restore path resets via Trainer.maybe_resume).
+        from dlrover_tpu.trainer.fault_tolerance import (
+            notify_progress_reset,
+        )
+
+        notify_progress_reset("rendezvous-resume")
         return world.round, world.world, rank_offset, total, world.coordinator_addr
 
 
@@ -289,6 +310,11 @@ class ElasticTrainingAgent:
         return env
 
     def _start_worker_processes(self, rank_offset, total, coordinator):
+        chaos_point(
+            "agent.spawn",
+            restart=self._restart_count,
+            rank_offset=rank_offset,
+        )
         self._workers = []
         self._log_files = []
         log_dir = self._config.log_dir or "/tmp/dlrover_tpu/logs"

@@ -16,6 +16,7 @@ from dlrover_tpu.brain import messages as bmsg
 from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.common.retry import NonCriticalGuard, noncritical_rpc_policy
 from dlrover_tpu.common.rpc import RpcClient
 from dlrover_tpu.master.resource import ResourceOptimizer, ResourcePlan
 
@@ -23,36 +24,54 @@ logger = get_logger(__name__)
 
 
 class BrainClient:
+    """Brain RPC client — NON-CRITICAL by design: it runs under the
+    short-budget retry policy and a :class:`NonCriticalGuard`, so a
+    dead or flapping brain service degrades this client to a no-op
+    (metrics dropped, no optimize plans) instead of stalling or
+    crashing the job that merely *reports* to it."""
+
     def __init__(self, addr: str):
-        self._rpc = RpcClient(addr)
+        self._rpc = RpcClient(addr, policy=noncritical_rpc_policy())
+        self._guard = NonCriticalGuard(f"brain-client[{addr}]")
+
+    @property
+    def degraded(self) -> bool:
+        return self._guard.disabled
 
     def persist_metrics(self, job_uuid: str, job_name: str,
                         metrics: dict) -> bool:
-        return self._rpc.report(
-            "brain-client", 0,
-            bmsg.PersistMetricsRequest(
-                job_uuid=job_uuid, job_name=job_name,
-                timestamp=time.time(), metrics=metrics,
+        return self._guard.run(
+            lambda: self._rpc.report(
+                "brain-client", 0,
+                bmsg.PersistMetricsRequest(
+                    job_uuid=job_uuid, job_name=job_name,
+                    timestamp=time.time(), metrics=metrics,
+                ),
             ),
+            default=False,
         )
 
     def optimize(self, job_uuid: str, job_name: str, opt_type: str,
                  config: dict | None = None) -> dict | None:
-        resp = self._rpc.get(
-            "brain-client", 0,
-            bmsg.OptimizeRequest(
-                job_uuid=job_uuid, job_name=job_name,
-                opt_type=opt_type, config=config or {},
-            ),
+        resp = self._guard.run(
+            lambda: self._rpc.get(
+                "brain-client", 0,
+                bmsg.OptimizeRequest(
+                    job_uuid=job_uuid, job_name=job_name,
+                    opt_type=opt_type, config=config or {},
+                ),
+            )
         )
         if isinstance(resp, bmsg.OptimizeResponse) and resp.found:
             return resp.plan
         return None
 
     def get_job_metrics(self, job_uuid: str) -> list:
-        resp = self._rpc.get(
-            "brain-client", 0,
-            bmsg.GetJobMetricsRequest(job_uuid=job_uuid),
+        resp = self._guard.run(
+            lambda: self._rpc.get(
+                "brain-client", 0,
+                bmsg.GetJobMetricsRequest(job_uuid=job_uuid),
+            )
         )
         if isinstance(resp, bmsg.JobMetricsResponse):
             return resp.records

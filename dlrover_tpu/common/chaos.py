@@ -1,0 +1,353 @@
+"""Seeded, deterministic chaos injection.
+
+Equivalent capability: the reference validates fault tolerance with
+ad-hoc mocks (``MOCK_ERR_RANK`` in node_check/utils.py:50) and manual
+kill experiments; CheckFreq-style checkpoint-consistency work shows that
+recovery invariants only hold when failures are injected *systematically*.
+This module is the one place every fault comes from: named **fault
+sites** threaded through the control plane (``rpc.send``, ``rpc.recv``,
+``ipc.request``, ``agent.spawn``, ``ckpt.write``, ``ckpt.manifest``,
+``ckpt.save``, ``rdzv.join``) consult a seeded schedule that can drop or
+delay RPC frames, kill or hang a process at a chosen step, tear a
+checkpoint payload mid-shard, or bit-flip persisted bytes.
+
+Determinism contract: a schedule carries one ``seed``; every rule draws
+from its own ``random.Random`` derived from (seed, rule index), so the
+fire pattern depends only on the schedule and the per-site call
+sequence — never on thread interleaving across *different* rules, wall
+time, or PYTHONHASHSEED.
+
+No-op contract: unless ``DLROVER_CHAOS`` is set (read ONCE at import),
+``chaos_point``/``chaos_transform`` are a module-global load plus an
+``is None`` branch — no env reads, no locks, no registry work in the
+hot path. Production binaries pay one predictable branch (plus the
+call-site kwargs) per site, all of which sit on paths already dominated
+by socket or disk IO.
+
+Enabling: ``DLROVER_CHAOS`` may be inline JSON (``{"seed":7,"rules":
+[...]}``), ``@/path/to/schedule.json``, or the name of a schedule in
+:data:`NAMED_SCHEDULES`. In-process tests use :func:`install` /
+:func:`uninstall`; subprocess workers inherit the env var and arm
+themselves at import.
+
+Rule fields (all optional except ``site`` and ``action``)::
+
+    site:   fault-site name, e.g. "rpc.send"
+    action: drop | disconnect | delay | hang | kill | error
+            | tear | bitflip           (tear/bitflip: transform sites)
+    prob:   fire probability per matching call (default 1.0, seeded)
+    step:   only fire when the site reports this training step
+    verb:   only fire for this RPC verb ("get"/"report")
+    msg:    only fire for these message type names (str or list)
+    after:  skip the first N matching calls
+    every:  fire on the first eligible call and every k-th thereafter
+            (eligible calls 1, 1+k, 1+2k, ...; default 1 = all)
+    max:    stop after this many fires (default unlimited)
+    delay:  seconds for delay/hang (default 0.2 / 3600)
+    frac:   fraction of payload kept by tear (default 0.5)
+    exit_code: status for kill (default 137)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+ENV_VAR = "DLROVER_CHAOS"
+
+_HANG_SECONDS = 3600.0
+_KILL_EXIT_CODE = 137
+
+
+class ChaosError(ConnectionError):
+    """Injected transport-level fault.
+
+    Subclasses ConnectionError so every existing retry/reconnect path
+    treats an injected drop exactly like a real dead peer — the whole
+    point is to exercise those paths, not to add a parallel one."""
+
+
+class ChaosRule:
+    """One (site, action) schedule entry with its own seeded RNG."""
+
+    _CONTROL_ACTIONS = (
+        "drop", "disconnect", "delay", "hang", "kill", "error",
+    )
+    _TRANSFORM_ACTIONS = ("tear", "bitflip")
+
+    def __init__(self, spec: dict, seed: int, index: int):
+        self.site = spec["site"]
+        self.action = spec["action"]
+        if self.action not in (
+            self._CONTROL_ACTIONS + self._TRANSFORM_ACTIONS
+        ):
+            raise ValueError(f"unknown chaos action {self.action!r}")
+        self.prob = float(spec.get("prob", 1.0))
+        self.step = spec.get("step")
+        self.verb = spec.get("verb")
+        msg = spec.get("msg")
+        self.msg = (msg,) if isinstance(msg, str) else (
+            tuple(msg) if msg else None
+        )
+        self.after = int(spec.get("after", 0))
+        self.every = max(int(spec.get("every", 1)), 1)
+        self.max_fires = spec.get("max")
+        self.delay = float(
+            spec.get(
+                "delay", _HANG_SECONDS if self.action == "hang" else 0.2
+            )
+        )
+        self.frac = float(spec.get("frac", 0.5))
+        self.exit_code = int(spec.get("exit_code", _KILL_EXIT_CODE))
+        # rule-local RNG: interleaving with OTHER rules can't perturb
+        # this rule's draw sequence
+        self._rng = random.Random(seed * 1000003 + index)
+        self._calls = 0
+        self._fires = 0
+
+    def _matches_ctx(self, ctx: dict) -> bool:
+        if self.step is not None and ctx.get("step") != self.step:
+            return False
+        if self.verb is not None and ctx.get("verb") != self.verb:
+            return False
+        if self.msg is not None and ctx.get("msg") not in self.msg:
+            return False
+        return True
+
+    def should_fire(self, ctx: dict) -> bool:
+        """Call-counting + probability draw; caller holds registry lock."""
+        if not self._matches_ctx(ctx):
+            return False
+        if self.max_fires is not None and self._fires >= self.max_fires:
+            return False
+        self._calls += 1
+        if self._calls <= self.after:
+            return False
+        if (self._calls - self.after - 1) % self.every != 0:
+            return False
+        if self.prob < 1.0 and self._rng.random() >= self.prob:
+            return False
+        self._fires += 1
+        return True
+
+    # ----------------------------------------------------------- actions
+
+    def apply(self, site: str, ctx: dict):
+        if self.action in ("drop", "disconnect", "error"):
+            raise ChaosError(
+                f"chaos[{self.action}] at {site} (ctx={ctx})"
+            )
+        if self.action in ("delay", "hang"):
+            time.sleep(self.delay)
+            return
+        if self.action == "kill":
+            logger.warning(
+                "chaos[kill] at %s (ctx=%s): exiting %d",
+                site, ctx, self.exit_code,
+            )
+            os._exit(self.exit_code)
+
+    def apply_transform(self, data, site: str, ctx: dict):
+        raw = bytes(data)
+        if self.action == "tear":
+            keep = int(len(raw) * self.frac)
+            logger.warning(
+                "chaos[tear] at %s: truncating %d -> %d bytes (ctx=%s)",
+                site, len(raw), keep, ctx,
+            )
+            return raw[:keep]
+        if self.action == "bitflip":
+            if not raw:
+                return raw
+            pos = self._rng.randrange(len(raw))
+            flipped = bytearray(raw)
+            flipped[pos] ^= 0x40
+            logger.warning(
+                "chaos[bitflip] at %s: byte %d of %d (ctx=%s)",
+                site, pos, len(raw), ctx,
+            )
+            return bytes(flipped)
+        # a control action listed on a transform site degrades to its
+        # control behavior (kill/hang during a write is a legit tear)
+        self.apply(site, ctx)
+        return bytes(data)
+
+
+class ChaosRegistry:
+    """Process-global schedule: all sites consult one instance."""
+
+    # recent-fires tail kept for assertions; counts are exact forever
+    MAX_FIRED_LOG = 1024
+
+    def __init__(self, schedule: dict):
+        self.seed = int(schedule.get("seed", 0))
+        self.rules = [
+            ChaosRule(spec, self.seed, i)
+            for i, spec in enumerate(schedule.get("rules", []))
+        ]
+        self._lock = threading.Lock()
+        # (site, action, ctx) tail so tests/tools can assert what fired
+        # — BOUNDED: an hours-long soak with a probability rule must not
+        # grow agent memory linearly with fires
+        self.fired: "deque[tuple[str, str, dict]]" = deque(
+            maxlen=self.MAX_FIRED_LOG
+        )
+        self._counts: dict[str, int] = {}
+
+    def _select(self, site: str, ctx: dict) -> list[ChaosRule]:
+        with self._lock:
+            out = []
+            for rule in self.rules:
+                if rule.site != site:
+                    continue
+                if rule.should_fire(ctx):
+                    self.fired.append((site, rule.action, dict(ctx)))
+                    key = f"{site}:{rule.action}"
+                    self._counts[key] = self._counts.get(key, 0) + 1
+                    out.append(rule)
+            return out
+
+    def fire(self, site: str, ctx: dict):
+        # apply OUTSIDE the lock: delay/hang must not serialize other
+        # sites, and kill would orphan the lock
+        for rule in self._select(site, ctx):
+            rule.apply(site, ctx)
+
+    def transform(self, site: str, data, ctx: dict):
+        for rule in self._select(site, ctx):
+            data = rule.apply_transform(data, site, ctx)
+        return data
+
+    def summary(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+
+# -------------------------------------------------------------------------
+# module-global arming
+# -------------------------------------------------------------------------
+
+_REGISTRY: ChaosRegistry | None = None
+
+
+def chaos_point(site: str, **ctx):
+    """Control-flow fault site. No-op unless a schedule is installed."""
+    reg = _REGISTRY
+    if reg is None:
+        return
+    reg.fire(site, ctx)
+
+
+def chaos_transform(site: str, data, **ctx):
+    """Byte-mutating fault site (checkpoint payloads, manifests).
+    Returns ``data`` unchanged (same object, no copy) when disarmed."""
+    reg = _REGISTRY
+    if reg is None:
+        return data
+    return reg.transform(site, data, ctx)
+
+
+def active_registry() -> ChaosRegistry | None:
+    return _REGISTRY
+
+
+def install(schedule: dict | str) -> ChaosRegistry:
+    """Arm a schedule in this process (tests/tools). ``schedule`` may be
+    a dict, inline JSON, ``@path``, or a :data:`NAMED_SCHEDULES` key."""
+    global _REGISTRY
+    _REGISTRY = ChaosRegistry(resolve_schedule(schedule))
+    logger.warning(
+        "chaos armed: seed=%d rules=%d",
+        _REGISTRY.seed, len(_REGISTRY.rules),
+    )
+    return _REGISTRY
+
+
+def uninstall():
+    global _REGISTRY
+    _REGISTRY = None
+
+
+def resolve_schedule(spec: dict | str) -> dict:
+    if isinstance(spec, dict):
+        return spec
+    spec = spec.strip()
+    if spec in NAMED_SCHEDULES:
+        return NAMED_SCHEDULES[spec]
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            return json.load(f)
+    return json.loads(spec)
+
+
+def install_from_env() -> ChaosRegistry | None:
+    """One env read, at import time — never in the hot path."""
+    spec = os.environ.get(ENV_VAR, "")
+    if not spec:
+        return None
+    try:
+        return install(spec)
+    except Exception as e:  # noqa: BLE001 - bad JSON, missing keys,
+        # wrong top-level type, unreadable @file ... a malformed
+        # schedule must not take the job down with it (this runs at
+        # import time in EVERY process)
+        logger.error("ignoring malformed %s=%r: %s", ENV_VAR, spec, e)
+        return None
+
+
+# -------------------------------------------------------------------------
+# named schedules (tools/chaos_run.py + docs)
+# -------------------------------------------------------------------------
+
+NAMED_SCHEDULES: dict[str, dict] = {
+    # kill the worker right after it finishes the step-5 shm save; the
+    # agent restarts it and it must resume from step 5
+    "worker-kill": {
+        "seed": 7,
+        "rules": [
+            {"site": "ckpt.save", "action": "kill", "step": 5},
+        ],
+    },
+    # flaky control plane while the world forms: drop the 1st, 3rd and
+    # 5th rendezvous RPCs; the RetryPolicy must ride it out.
+    # Deterministic counting, not probability — the rendezvous window
+    # is only a handful of calls and a replay must actually flap.
+    "rdzv-flap": {
+        "seed": 11,
+        "rules": [
+            {
+                "site": "rpc.send",
+                "action": "drop",
+                "msg": ["JoinRendezvousRequest", "CommWorldRequest"],
+                "every": 2,
+                "max": 3,
+            },
+        ],
+    },
+    # tear the final persisted checkpoint mid-shard: restore must fall
+    # back to the newest verified step instead of loading torn bytes
+    "torn-ckpt": {
+        "seed": 13,
+        "rules": [
+            {"site": "ckpt.write", "action": "tear", "step": 8},
+        ],
+    },
+    # bit-flip the newest manifest: verification must reject the step
+    "manifest-bitflip": {
+        "seed": 17,
+        "rules": [
+            {"site": "ckpt.manifest", "action": "bitflip", "step": 8},
+        ],
+    },
+}
+
+
+install_from_env()

@@ -20,6 +20,7 @@ import threading
 import time
 from multiprocessing import resource_tracker, shared_memory
 
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.framing import recv_frame, send_frame
 from dlrover_tpu.common.log import get_logger
 
@@ -46,6 +47,7 @@ def socket_path(kind: str, name: str) -> str:
 
 
 def _rpc_over_unix_socket(path: str, request: tuple, timeout: float = 30.0):
+    chaos_point("ipc.request", method=request[0] if request else "")
     with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
         sock.settimeout(timeout)
         sock.connect(path)
@@ -354,9 +356,14 @@ def get_or_create_shm(name: str, size: int = 0) -> PersistentSharedMemory:
 
 
 def wait_for_path(path: str, timeout: float = 60.0, interval=0.1) -> bool:
-    start = time.time()
-    while time.time() - start < timeout:
+    """Poll until ``path`` exists. Always checks at least once, so a
+    zero/negative timeout degrades to a plain existence probe instead of
+    unconditionally returning False for a path that is already there."""
+    deadline = time.time() + timeout
+    while True:
         if os.path.exists(path):
             return True
-        time.sleep(interval)
-    return False
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            return False
+        time.sleep(min(interval, remaining))

@@ -21,11 +21,17 @@ import socketserver
 import threading
 import time
 
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.framing import (
     recv_frame as _recv_frame,
     send_frame as _send_frame,
 )
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.retry import (
+    RetryPolicy,
+    default_rpc_policy,
+    run_with_retry,
+)
 from dlrover_tpu.common.serialize import deserialize_message, serialize_message
 
 logger = get_logger(__name__)
@@ -113,19 +119,35 @@ class RpcClient:
     """Persistent-connection client with reconnect + retry.
 
     Mirrors the reference MasterClient retry decorator
-    (master_client.py:27 ``retry_grpc_request``).
+    (master_client.py:27 ``retry_grpc_request``), upgraded to the shared
+    :class:`~dlrover_tpu.common.retry.RetryPolicy`: exponential backoff
+    with full jitter and a per-call total-deadline budget, configured in
+    ONE place (`DLROVER_RPC_*` env) instead of per-call-site defaults.
     """
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        addr: str,
+        timeout: float = 30.0,
+        policy: RetryPolicy | None = None,
+    ):
         self._addr = addr
         self._timeout = timeout
+        self._policy = policy
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
 
-    def _connect(self):
+    @property
+    def policy(self) -> RetryPolicy:
+        # resolved lazily so a policy configured via env after client
+        # construction (tests, launchers) still takes effect
+        return self._policy or default_rpc_policy()
+
+    def _connect(self, timeout: float | None = None):
         host, _, port = self._addr.rpartition(":")
         sock = socket.create_connection(
-            (host or "127.0.0.1", int(port)), timeout=self._timeout
+            (host or "127.0.0.1", int(port)),
+            timeout=self._timeout if timeout is None else timeout,
         )
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
@@ -141,37 +163,104 @@ class RpcClient:
             finally:
                 self._sock = None
 
-    def _call_once(self, body: bytes):
+    def _call_once(self, body: bytes, timeout: float | None = None):
+        """One round-trip. ``timeout`` (when given) clamps the socket
+        timeout for this attempt — the caller passes the remaining
+        deadline budget so a single blocking connect/recv cannot
+        overshoot the policy's total-deadline by the full transport
+        timeout."""
+        if timeout is not None:
+            timeout = min(self._timeout, max(timeout, 0.05))
         if self._sock is None:
-            self._connect()
+            self._connect(timeout)
         assert self._sock is not None
+        if timeout is not None:
+            self._sock.settimeout(timeout)
         _send_frame(self._sock, body)
         return deserialize_message(_recv_frame(self._sock))
 
-    def call(self, verb: str, node_type: str, node_id: int, message, retries=3):
-        body = serialize_message((verb, node_type, node_id, message))
-        with self._lock:
-            last_err: Exception | None = None
-            for attempt in range(retries):
-                try:
-                    ok, payload = self._call_once(body)
-                    if not ok and verb == "get":
-                        raise RuntimeError(f"rpc error: {payload}")
-                    return ok, payload
-                except (ConnectionError, OSError) as e:
-                    last_err = e
-                    self._close_nolock()
-                    if attempt < retries - 1:
-                        time.sleep(min(2**attempt, 5))
-            raise ConnectionError(
-                f"rpc to {self._addr} failed after {retries} tries: {last_err}"
-            )
+    def call(
+        self,
+        verb: str,
+        node_type: str,
+        node_id: int,
+        message,
+        retries: int | None = None,
+    ):
+        """One verb round-trip under the retry policy.
 
-    def get(self, node_type: str, node_id: int, message, retries: int = 3):
+        ``retries`` overrides the policy's attempt count for callers
+        that want fail-fast semantics (e.g. best-effort stats reports);
+        backoff/jitter/deadline still come from the shared policy.
+
+        The connection lock is held only around the socket round-trip —
+        NEVER across backoff sleeps — so one dead master stalls a caller
+        thread for at most one attempt, not the whole retry window.
+        """
+        body = serialize_message((verb, node_type, node_id, message))
+        policy = self.policy
+        if retries is not None:
+            policy = policy.with_attempts(retries)
+        msg_type = type(message).__name__
+        attempt_counter = iter(range(1 << 30))
+        start = time.monotonic()
+
+        def _attempt():
+            attempt = next(attempt_counter)
+            chaos_point(
+                "rpc.send", verb=verb, msg=msg_type, attempt=attempt
+            )
+            with self._lock:
+                # budget computed under the lock: time spent queued
+                # behind another thread's attempt must come out of THIS
+                # attempt's clamp, or the overshoot the clamp exists to
+                # prevent comes back under contention
+                remaining = policy.deadline - (
+                    time.monotonic() - start
+                )
+                try:
+                    ok, payload = self._call_once(
+                        body, timeout=remaining
+                    )
+                except (ConnectionError, OSError):
+                    # drop the connection INSIDE this lock hold: after a
+                    # timed-out/partial round-trip the stream is out of
+                    # sync, and another thread grabbing the lock before
+                    # cleanup would read this attempt's late response as
+                    # its own reply
+                    self._close_nolock()
+                    raise
+            chaos_point(
+                "rpc.recv", verb=verb, msg=msg_type, attempt=attempt
+            )
+            if not ok and verb == "get":
+                raise RuntimeError(f"rpc error: {payload}")
+            return ok, payload
+
+        def _drop_connection(_err):
+            # covers failures raised OUTSIDE the locked round-trip (an
+            # injected chaos drop before send): reconnect next attempt
+            with self._lock:
+                self._close_nolock()
+
+        return run_with_retry(
+            _attempt,
+            policy,
+            on_failure=_drop_connection,
+            describe=f"rpc to {self._addr}",
+        )
+
+    def get(
+        self, node_type: str, node_id: int, message,
+        retries: int | None = None,
+    ):
         _, payload = self.call("get", node_type, node_id, message, retries)
         return payload
 
-    def report(self, node_type: str, node_id: int, message, retries=3) -> bool:
+    def report(
+        self, node_type: str, node_id: int, message,
+        retries: int | None = None,
+    ) -> bool:
         ok, _ = self.call("report", node_type, node_id, message, retries)
         return ok
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 import time
 
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import (
     JobConstant,
     NetworkFailureReason,
@@ -100,6 +101,9 @@ class RendezvousManager:
     def join_rendezvous(
         self, node_rank: int, local_world_size: int, node_ip: str = ""
     ) -> int:
+        # master-side fault site: a dropped/delayed join is the server
+        # half of a flaky control plane (the client half is rpc.send)
+        chaos_point("rdzv.join", rank=node_rank, name=self.name)
         with self._lock:
             if not self._waiting_nodes:
                 self._first_join_time = time.time()
@@ -342,6 +346,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     def join_rendezvous(
         self, node_rank: int, local_world_size: int, node_ip: str = ""
     ) -> int:
+        chaos_point("rdzv.join", rank=node_rank, name=self.name)
         with self._lock:
             if not self._waiting_nodes:
                 self._first_join_time = time.time()

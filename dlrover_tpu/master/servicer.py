@@ -36,9 +36,14 @@ class CheckpointBarrierService:
         # (group, step) -> set of node ids that said ready (insertion
         # ordered: oldest evicted first)
         self._ready: dict[tuple[str, int], set[int]] = {}
-        # (group, step) entries some participant abandoned (lock busy):
-        # peers stop waiting immediately
-        self._aborted: dict[tuple[str, int], bool] = {}
+        # (group, step) -> node ids that abandoned the step (lock busy):
+        # peers stop waiting immediately. Per-NODE, not a sticky bool:
+        # a skipper that retries the same step (the trainer's final-
+        # checkpoint retry loop) re-reports ready and un-aborts itself;
+        # the barrier stays aborted only while some OTHER node's skip
+        # stands, so a single transient skip cannot poison the step
+        # forever.
+        self._aborted: dict[tuple[str, int], set[int]] = {}
         # node agreement that step shards were persisted
         self._persisted: dict[int, set[int]] = {}
 
@@ -51,11 +56,19 @@ class CheckpointBarrierService:
         ready: bool = True,
     ):
         with self._lock:
+            key = (group, step)
             if not ready:
-                self._aborted[(group, step)] = True
+                self._aborted.setdefault(key, set()).add(node_id)
                 self._evict(self._aborted)
                 return False
-            members = self._ready.setdefault((group, step), set())
+            skippers = self._aborted.get(key)
+            if skippers is not None:
+                # this node retried the step it once skipped; its own
+                # abort no longer stands
+                skippers.discard(node_id)
+                if not skippers:
+                    del self._aborted[key]
+            members = self._ready.setdefault(key, set())
             members.add(node_id)
             self._evict(self._ready)
             return len(members) >= world

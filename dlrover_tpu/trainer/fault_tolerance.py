@@ -17,11 +17,27 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Callable, Optional
 
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
+
+# Started detectors, so resume events (checkpoint restore, rendezvous)
+# can reset every stall clock in the process without plumbing detector
+# handles through the trainer stack. Weak: a dropped detector must not
+# be kept alive by the registry.
+_ACTIVE: "weakref.WeakSet[HangingDetector]" = weakref.WeakSet()
+
+
+def notify_progress_reset(reason: str = ""):
+    """Reset the stall clock of every active detector. Call after a
+    checkpoint restore or rendezvous resume: wall time passed while no
+    step COULD progress, and a restart right after a long restore must
+    not be misclassified as a hang."""
+    for det in list(_ACTIVE):
+        det.reset_progress(reason)
 
 
 class HangingDetector:
@@ -53,6 +69,16 @@ class HangingDetector:
         self._last_progress = time.time()
         self._hang_reported = False
 
+    def reset_progress(self, reason: str = ""):
+        """Restart the stall clock WITHOUT claiming a new step — for
+        resume events (restore/rendezvous) where elapsed wall time says
+        nothing about training progress. Unlike report_progress, the
+        step counter is untouched, so the next real step still counts."""
+        self._last_progress = time.time()
+        self._hang_reported = False
+        if reason:
+            logger.info("hang-detector clock reset (%s)", reason)
+
     def is_hanging(self) -> bool:
         return time.time() - self._last_progress > self._timeout
 
@@ -61,6 +87,11 @@ class HangingDetector:
     def start(self):
         if self._thread is not None:
             return
+        _ACTIVE.add(self)
+        # the monitoring clock starts NOW — construction time may be
+        # long before start (model build, compile), and that gap is not
+        # a hang
+        self.reset_progress()
         self._thread = threading.Thread(
             target=self._loop, name="hang-detector", daemon=True
         )
@@ -68,6 +99,7 @@ class HangingDetector:
 
     def stop(self):
         self._stopped.set()
+        _ACTIVE.discard(self)
 
     def _loop(self):
         while not self._stopped.is_set():

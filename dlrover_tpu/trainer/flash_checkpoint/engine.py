@@ -44,7 +44,9 @@ from dlrover_tpu.agent.ckpt_saver import (
     host_shard_filename,
     lock_name,
     read_host_shard,
+    verify_step_dir,
 )
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.ipc import SharedLock, SharedQueue
 from dlrover_tpu.common.log import get_logger
@@ -404,6 +406,10 @@ class CheckpointEngine:
             elapsed,
             offset / 1e6,
         )
+        # fault site AFTER the shm save committed: a kill here is the
+        # canonical "worker dies right after checkpointing step N" —
+        # the agent-held shm segment must carry the restore
+        chaos_point("ckpt.save", step=step)
         return True
 
     def save_to_memory_async(
@@ -612,20 +618,109 @@ class CheckpointEngine:
         return _fill_target(state, target, meta.step)
 
     def load_from_storage(self, path: str = "", target=None):
-        """Restore from a step directory.
+        """Restore from storage with VERIFIED fallback.
+
+        Candidate step dirs are tried newest-first; each must pass
+        :func:`verify_step_dir` (per-shard manifest: payload size +
+        recomputed checksum) before a single byte is deserialized, so a
+        torn or bit-flipped newest checkpoint makes restore fall back to
+        the newest *complete, verified* step instead of loading garbage
+        or refusing entirely. An explicit ``path`` is verified too, and
+        a named-but-corrupt checkpoint RAISES instead of silently
+        degrading to train-from-scratch — the caller asked for that
+        exact state, so nothing else can substitute for it. (A named
+        path that does not exist keeps returning None — "restore if
+        present" probing predates this contract — but is loudly
+        logged.)
+
+        Verification depth follows the load path: the eager
+        (targetless) loader re-checks every payload's embedded crc
+        itself, so it gets the cheap structural/size verify; the
+        targeted shard-wise loader does crc-less slice reads, so its
+        candidates get the deep payload-crc verify.
+        """
+        candidates = [path] if path else self._candidate_step_dirs()
+        for step_dir in candidates:
+            if not step_dir or not os.path.isdir(step_dir):
+                if path:
+                    logger.warning(
+                        "explicitly named checkpoint path %s does not "
+                        "exist; treating as no checkpoint", path,
+                    )
+                continue
+            ok, reason = verify_step_dir(
+                step_dir, deep=target is not None
+            )
+            if not ok:
+                if path:
+                    raise ValueError(
+                        f"checkpoint at {step_dir} failed integrity "
+                        f"verification ({reason}) — refusing to load "
+                        f"an explicitly named torn/corrupt checkpoint"
+                    )
+                logger.warning(
+                    "checkpoint %s failed integrity verification (%s); "
+                    "falling back to an older checkpoint",
+                    step_dir, reason,
+                )
+                continue
+            result = self._load_step_dir(step_dir, target)
+            if result is not None:
+                return result
+            if path:
+                # shallow verify can pass (size ok) while the loader's
+                # own payload-crc check rejects the shard, or the dir
+                # may be missing shards: a named checkpoint that cannot
+                # be loaded must raise, not silently train from scratch
+                raise ValueError(
+                    f"checkpoint at {step_dir} is incomplete or failed "
+                    f"its payload checks — refusing to substitute "
+                    f"anything for an explicitly named checkpoint"
+                )
+            logger.warning(
+                "checkpoint %s is incomplete; falling back to an older "
+                "checkpoint", step_dir,
+            )
+        return None
+
+    def _candidate_step_dirs(self) -> list[str]:
+        """All persisted step dirs, newest first. The tracker's step is
+        just the first candidate — a tracker advertising a step whose
+        dir fails verification must not brick the restore."""
+        prefix = CheckpointConstant.STEP_DIR_PREFIX
+        steps: set[int] = set()
+        tracker_step = AsyncCheckpointSaver.get_latest_step(
+            self.checkpoint_dir
+        )
+        if tracker_step >= 0:
+            steps.add(tracker_step)
+        try:
+            for name in os.listdir(self.checkpoint_dir):
+                if not name.startswith(prefix) or name.endswith(".tmp"):
+                    continue
+                try:
+                    steps.add(int(name[len(prefix):]))
+                except ValueError:
+                    continue
+        except OSError:
+            pass
+        return [
+            os.path.join(self.checkpoint_dir, f"{prefix}{s}")
+            for s in sorted(steps, reverse=True)
+        ]
+
+    def _load_step_dir(self, step_dir: str, target=None):
+        """Deserialize ONE verified step directory.
 
         With a ``target``, the restore is SHARD-WISE (reference
         fsdp_engine.py:341 FileReader): only metas are unpickled, and
         each target device shard reads just the byte ranges of the saved
         pieces it intersects via ``np.memmap`` — peak extra host memory
         is ~one shard, not the global array, so restoring a 7B-class
-        state into a *different* mesh cannot OOM the host. The trade:
-        slice reads skip the whole-payload CRC (the targetless eager
-        path keeps it).
+        state into a *different* mesh cannot OOM the host. (Slice reads
+        skip the whole-payload CRC; verify_step_dir already covered
+        integrity for both paths.)
         """
-        step_dir = path or self._latest_step_dir()
-        if not step_dir or not os.path.isdir(step_dir):
-            return None
         if target is not None:
             return self._load_storage_sharded(step_dir, target)
         entries: list[tuple[LeafMeta, np.ndarray]] = []
@@ -766,15 +861,6 @@ class CheckpointEngine:
             new_leaves.append(arr)
         return (
             jax.tree_util.tree_unflatten(treedef, new_leaves), step,
-        )
-
-    def _latest_step_dir(self) -> str:
-        step = AsyncCheckpointSaver.get_latest_step(self.checkpoint_dir)
-        if step < 0:
-            return ""
-        return os.path.join(
-            self.checkpoint_dir,
-            f"{CheckpointConstant.STEP_DIR_PREFIX}{step}",
         )
 
     def latest_step(self) -> int:

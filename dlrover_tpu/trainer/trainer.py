@@ -139,6 +139,9 @@ class Trainer:
         )
         self.state = self._accel.state
         self.global_step = 0
+        # step the on-disk pending/latest prestep sidecar was last
+        # serialized at (skip-rewrite cache; None = dirty)
+        self._prestep_sidecar_step = None
         self._engine = None
         if args.flash_checkpoint:
             from dlrover_tpu.trainer.flash_checkpoint.engine import (
@@ -260,6 +263,15 @@ class Trainer:
             self.state = tree
         self.global_step = int(step)
         self._restore_prestep_state()
+        # a multi-GB restore can take minutes of wall time with zero
+        # step progress; any active hang detector must restart its
+        # stall clock or the fresh incarnation gets relaunched for
+        # "hanging" right out of restore
+        from dlrover_tpu.trainer.fault_tolerance import (
+            notify_progress_reset,
+        )
+
+        notify_progress_reset("checkpoint-restore")
         logger.info("resumed from checkpoint step %s", step)
         return self.global_step
 
@@ -338,10 +350,24 @@ class Trainer:
                     stop = True
                     break
         if self._engine is not None:
-            self.save_checkpoint(persist=True)
-            self._engine.wait_for_persist(
-                self.global_step, timeout=300
-            )
+            # The final checkpoint must not be lost to a cadence save's
+            # persist still holding the shm lock: a silently skipped
+            # save here would strand wait_for_persist on a step that
+            # never arrives and drop the end-of-run state entirely.
+            # Bounded retry until the in-flight persist drains.
+            deadline = time.time() + 120
+            while not self.save_checkpoint(persist=True):
+                if time.time() >= deadline:
+                    logger.error(
+                        "final checkpoint save at step %d kept getting "
+                        "skipped; giving up", self.global_step,
+                    )
+                    break
+                time.sleep(0.2)
+            else:
+                self._engine.wait_for_persist(
+                    self.global_step, timeout=300
+                )
         return self.state, metrics
 
     # --------------------------------------------------------- checkpoints
@@ -350,19 +376,34 @@ class Trainer:
         if self._engine is None:
             return False
         tree = self._ckpt_tree()
+        # PENDING sidecar before the engine commit, promoted to latest
+        # only after the save succeeds: a crash on either side of the
+        # engine's two-phase shm publish (e.g. a worker killed right
+        # after the save — the canonical chaos scenario) leaves the
+        # restored step matching either the pending sidecar (crash
+        # after publish, before promote) or the promoted latest one
+        # (crash before publish, and any number of SKIPPED saves),
+        # so resume never hard-fails on a step-mismatched pair.
+        self._write_prestep_pending()
         if persist:
             ok = self._engine.save_to_storage(self.global_step, tree)
         else:
             ok = self._engine.save_to_memory(self.global_step, tree)
         if ok:
-            self._save_prestep_state(persist)
+            self._promote_prestep_pending(persist)
         return ok
 
-    # two sidecars: the latest (memory-cadence) save and the latest
-    # PERSISTED save — a restore can land on either source (shm vs
-    # storage), and the mapper must pair with the exact table step it
-    # was saved with; a mismatched pair silently scrambles embeddings
-    _PRESTEP_FILES = ("prestep_state.npy", "prestep_state_persist.npy")
+    # three sidecars: the latest SUCCESSFUL (memory-cadence) save, the
+    # pre-commit PENDING one (crash bracket, see save_checkpoint), and
+    # the latest PERSISTED save — a restore can land on any of those
+    # steps (shm vs storage vs interrupted commit), and the mapper must
+    # pair with the exact table step it was saved with; a mismatched
+    # pair silently scrambles embeddings
+    _PRESTEP_FILES = (
+        "prestep_state.npy",
+        "prestep_state_pending.npy",
+        "prestep_state_persist.npy",
+    )
 
     def _prestep_stateful(self) -> bool:
         """Save and restore must gate on the SAME capability check — a
@@ -372,15 +413,24 @@ class Trainer:
             self.prestep, "load_state_dict"
         )
 
-    def _save_prestep_state(self, persist: bool):
+    def _write_prestep_pending(self):
         """Sidecar for stateful prestep hooks (e.g. a tiered embedding's
         id -> slot mapper + host rows): variable-sized host arrays can't
         ride the engine's shape-matched tree, so they are written next
         to the checkpoint at every save, tagged with the step so resume
-        can refuse a mismatched pair. Runs at memory-save cadence
-        because shm is the preferred restore source — with a very large
-        host tier, raise ``save_steps`` to bound the sidecar I/O."""
+        can refuse a mismatched pair. Written to the PENDING slot before
+        the engine commit (promoted on success): the latest sidecar only
+        ever advances in lockstep with a save that actually landed.
+        Runs at memory-save cadence because shm is the preferred restore
+        source — with a very large host tier, raise ``save_steps`` to
+        bound the sidecar I/O."""
         if not self._prestep_stateful():
+            return
+        # the prestep state cannot change while global_step stands
+        # still, so retries of the same step (the final-save retry
+        # loop) must not re-serialize a possibly multi-GB host tier
+        # every 200 ms
+        if self._prestep_sidecar_step == self.global_step:
             return
         import numpy as np
 
@@ -390,27 +440,106 @@ class Trainer:
              "state": self.prestep.state_dict()},
             dtype=object,
         )
+        pending = os.path.join(
+            self.args.output_dir, self._PRESTEP_FILES[1]
+        )
+        tmp = pending + ".tmp"
+        with open(tmp, "wb") as f:  # np.save(str) appends .npy
+            np.save(f, payload, allow_pickle=True)
+        os.replace(tmp, pending)
+        self._prestep_sidecar_step = self.global_step
+
+    def _promote_prestep_pending(self, persist: bool):
+        """The save landed: the pending sidecar becomes the latest (and
+        the persist snapshot when the save persisted). Rename + hard
+        link — no second serialization of the host tier. The pending
+        file may already have been promoted by an earlier success at
+        the same step (skipped rewrite); the persist link then snapshots
+        the promoted latest."""
+        if not self._prestep_stateful():
+            return
+        pending = os.path.join(
+            self.args.output_dir, self._PRESTEP_FILES[1]
+        )
         latest = os.path.join(
             self.args.output_dir, self._PRESTEP_FILES[0]
         )
-        tmp = latest + ".tmp"
-        with open(tmp, "wb") as f:  # np.save(str) appends .npy
-            np.save(f, payload, allow_pickle=True)
-        os.replace(tmp, latest)
+        if os.path.exists(pending):
+            os.replace(pending, latest)
+        if not os.path.exists(latest):
+            return
         if persist:
-            # snapshot by hard-link (fall back to copy): the persist
-            # file keeps this inode when the latest file is later
-            # replaced — no second serialization of the host tier
-            dst = os.path.join(
-                self.args.output_dir, self._PRESTEP_FILES[1]
-            )
-            try:
-                os.link(latest, tmp)
-            except OSError:
-                import shutil
+            for dst in (
+                os.path.join(
+                    self.args.output_dir, self._PRESTEP_FILES[2]
+                ),
+                # one snapshot PER persisted step: the engine's
+                # verified-restore may fall back past the newest step
+                # (torn/bit-flipped shards), and the matching mapper for
+                # that older step must still exist or the fallback dead-
+                # ends in a step-mismatch refusal
+                os.path.join(
+                    self.args.output_dir,
+                    self._PRESTEP_STEP_PREFIX
+                    + f"{self.global_step}.npy",
+                ),
+            ):
+                tmp = dst + ".tmp"
+                try:
+                    os.link(latest, tmp)
+                except OSError:
+                    import shutil
 
-                shutil.copyfile(latest, tmp)
-            os.replace(tmp, dst)
+                    shutil.copyfile(latest, tmp)
+                os.replace(tmp, dst)
+            self._prune_prestep_steps()
+
+    _PRESTEP_STEP_PREFIX = "prestep_state_step"
+    _PRESTEP_KEEP_STEPS = 4
+
+    def _prestep_keep_steps(self) -> int:
+        """Per-step sidecar retention follows the checkpoint retention
+        policy when one is configured (a verified fallback can only
+        land on a retained step dir, and its sidecar must still
+        exist); otherwise a fixed recent window."""
+        try:
+            keep = int(
+                os.environ.get("DLROVER_TPU_MAX_CKPTS_TO_KEEP", "0")
+            )
+        except ValueError:
+            keep = 0
+        return max(keep, self._PRESTEP_KEEP_STEPS)
+
+    def _prestep_step_files(self) -> list[str]:
+        """Per-persisted-step sidecar snapshots, newest step first."""
+        import glob
+
+        def step_of(p):
+            stem = os.path.basename(p)[
+                len(self._PRESTEP_STEP_PREFIX):-len(".npy")
+            ]
+            try:
+                return int(stem)
+            except ValueError:
+                return -1
+
+        return sorted(
+            glob.glob(os.path.join(
+                self.args.output_dir,
+                self._PRESTEP_STEP_PREFIX + "*.npy",
+            )),
+            key=step_of,
+            reverse=True,
+        )
+
+    def _prune_prestep_steps(self):
+        for path in self._prestep_step_files()[
+            self._prestep_keep_steps():
+        ]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     def _restore_prestep_state(self):
         """Load the sidecar whose step matches the restored checkpoint
@@ -422,15 +551,29 @@ class Trainer:
         import numpy as np
 
         seen_steps = []
-        for name in self._PRESTEP_FILES:
-            path = os.path.join(self.args.output_dir, name)
+        candidates = [
+            os.path.join(self.args.output_dir, name)
+            for name in self._PRESTEP_FILES
+        ] + self._prestep_step_files()
+        for path in candidates:
             if not os.path.exists(path):
                 continue
-            payload = np.load(path, allow_pickle=True).item()
-            if int(payload["step"]) == self.global_step:
+            try:
+                payload = np.load(path, allow_pickle=True).item()
+                step = int(payload["step"])
+            except Exception as e:  # noqa: BLE001 - torn/bit-rotted
+                # sidecar: skip it and keep scanning — another snapshot
+                # (persist copy, per-step file) may match, and a crash
+                # loop over one rotten file would be strictly worse
+                logger.warning(
+                    "unreadable prestep sidecar %s (%s); skipping", path, e
+                )
+                continue
+            if step == self.global_step:
                 self.prestep.load_state_dict(payload["state"])
                 return
-            seen_steps.append(int(payload["step"]))
+            seen_steps.append(step)
+        seen_steps = sorted(set(seen_steps))
         if os.environ.get("DLROVER_TPU_IGNORE_CKPT"):
             logger.warning(
                 "no prestep sidecar matches restored step %s (found "
@@ -478,6 +621,12 @@ class Trainer:
                 else:
                     self.state, batch = self.prestep(self.state, batch)
             losses.append(eval_step(self.state.params, batch))
+        if self.prestep is not None:
+            # eval's prepare_batch mutates row PLACEMENT at an
+            # unchanged global_step: the same-step sidecar-skip cache
+            # must not let a later save pair the post-eval table with a
+            # pre-eval mapper snapshot
+            self._prestep_sidecar_step = None
         loss = float(jnp.mean(jnp.stack(losses))) if losses else float(
             "nan"
         )

@@ -8,6 +8,8 @@ star: fast restore under injected preemption).
 
 import json
 
+import pytest
+
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.training_agent import (
     ElasticLaunchConfig,
@@ -15,6 +17,8 @@ from dlrover_tpu.agent.training_agent import (
     WorkerSpec,
 )
 from dlrover_tpu.common.constants import NodeType
+
+pytestmark = pytest.mark.chaos
 
 
 WORKER = """

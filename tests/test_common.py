@@ -262,3 +262,84 @@ class TestSecurityFixes:
         assert client.get("w", 0, msg.GlobalStep(step=2)).step == 2
         client.close()
         server2.stop()
+
+
+class TestIpcTimeoutEdges:
+    """wait_for_path / SharedQueue deadline-slice edge cases (the paths
+    a restart storm actually exercises)."""
+
+    def test_wait_for_path_zero_timeout_existing(self, tmp_path):
+        from dlrover_tpu.common.ipc import wait_for_path
+
+        p = tmp_path / "present"
+        p.write_text("x")
+        # zero/negative timeout must still probe once, not blind-fail
+        assert wait_for_path(str(p), timeout=0)
+        assert wait_for_path(str(p), timeout=-1)
+
+    def test_wait_for_path_zero_timeout_missing_is_fast(self, tmp_path):
+        from dlrover_tpu.common.ipc import wait_for_path
+
+        start = time.monotonic()
+        assert not wait_for_path(str(tmp_path / "never"), timeout=0)
+        assert not wait_for_path(str(tmp_path / "never"), timeout=-5)
+        assert time.monotonic() - start < 0.5
+
+    def test_wait_for_path_appears_mid_wait(self, tmp_path):
+        from dlrover_tpu.common.ipc import wait_for_path
+
+        p = tmp_path / "late"
+
+        def create():
+            time.sleep(0.2)
+            p.write_text("x")
+
+        t = threading.Thread(target=create, daemon=True)
+        t.start()
+        assert wait_for_path(str(p), timeout=5.0, interval=0.05)
+        t.join()
+
+    def test_queue_get_zero_timeout_raises_promptly(self):
+        import queue as _q
+
+        q = SharedQueue(name=f"z{os.getpid()}", create=True)
+        try:
+            start = time.monotonic()
+            with pytest.raises(_q.Empty):
+                q.get(timeout=0)
+            with pytest.raises(_q.Empty):
+                q.get(timeout=-1)  # negative deadline slice
+            with pytest.raises(_q.Empty):
+                q.get(block=False)
+            assert time.monotonic() - start < 1.0
+        finally:
+            q.unlink()
+
+    def test_queue_get_subslice_timeout_bounded(self):
+        """A timeout smaller than the server-side slice must still
+        return near the requested deadline, not the 5s slice."""
+        import queue as _q
+
+        q = SharedQueue(name=f"sub{os.getpid()}", create=True)
+        try:
+            start = time.monotonic()
+            with pytest.raises(_q.Empty):
+                q.get(timeout=0.3)
+            elapsed = time.monotonic() - start
+            assert 0.2 <= elapsed < 2.0, elapsed
+        finally:
+            q.unlink()
+
+    def test_queue_item_survives_expired_getter(self):
+        """Orphan-handler retry path: a getter that timed out must not
+        have a server-side slice eat the item a later getter came for."""
+        import queue as _q
+
+        q = SharedQueue(name=f"orph{os.getpid()}", create=True)
+        try:
+            with pytest.raises(_q.Empty):
+                q.get(timeout=0.2)  # expires; its slice drains empty
+            q.put({"step": 7})
+            assert q.get(timeout=2.0)["step"] == 7
+        finally:
+            q.unlink()

@@ -297,6 +297,35 @@ class TestKVStoreAndBarrier:
             c0.close()
             c1.close()
 
+    def test_ckpt_barrier_skip_is_not_sticky_for_retries(self, local_master):
+        """A skipper that RETRIES the same step (the trainer's final-
+        checkpoint retry loop) must be able to un-abort it: the abort
+        stands only while some other node's skip does."""
+        c0 = make_client(local_master, 0)
+        c1 = make_client(local_master, 1)
+        try:
+            c0.report_ckpt_ready(12, "g", world=2)
+            c1.report_ckpt_skip(12, "g")
+            assert c0.check_ckpt_barrier(12, "g", world=2) == (
+                False, True,
+            )
+            # the skipper retries: its own abort is lifted and the
+            # earlier ready reports still count
+            c1.report_ckpt_ready(12, "g", world=2)
+            assert c0.check_ckpt_barrier(12, "g", world=2) == (
+                True, False,
+            )
+            # but another node's standing skip keeps the step aborted
+            c0.report_ckpt_ready(13, "g", world=2)
+            c1.report_ckpt_skip(13, "g")
+            c0.report_ckpt_ready(13, "g", world=2)  # not the skipper
+            assert c0.check_ckpt_barrier(13, "g", world=2) == (
+                False, True,
+            )
+        finally:
+            c0.close()
+            c1.close()
+
 
 class TestHeartbeatAndMetrics:
     def test_heartbeat_marks_running(self, local_master):

@@ -60,6 +60,68 @@ class TestHangingDetector:
         det.report_progress(5)  # stuck at same step
         assert det.is_hanging()
 
+    def test_reset_progress_clears_stale_clock(self):
+        """A restart right after a long checkpoint restore must not be
+        misclassified as a hang: reset_progress restarts the stall
+        clock without claiming a training step."""
+        det = HangingDetector(timeout=0.2)
+        det.report_progress(5)
+        time.sleep(0.3)
+        assert det.is_hanging()
+        det.reset_progress("checkpoint-restore")
+        assert not det.is_hanging()
+        # the step counter is untouched: the NEXT step still counts as
+        # progress even though it is > last reported step
+        assert det._last_step == 5
+        det.report_progress(6)
+        assert not det.is_hanging()
+
+    def test_notify_progress_reset_reaches_active_detectors(self):
+        from dlrover_tpu.trainer.fault_tolerance import (
+            notify_progress_reset,
+        )
+
+        fired = []
+        det = HangingDetector(
+            timeout=0.25, check_interval=0.05,
+            on_hang=lambda: fired.append(1),
+        )
+        det.start()
+        try:
+            for _ in range(4):
+                time.sleep(0.15)
+                notify_progress_reset("rendezvous-resume")
+            assert not det.is_hanging()
+            assert not fired, "resume resets did not suppress the hang"
+        finally:
+            det.stop()
+
+    def test_stopped_detector_not_resettable_via_registry(self):
+        from dlrover_tpu.trainer import fault_tolerance as ft
+
+        det = HangingDetector(timeout=0.2)
+        det.start()
+        det.stop()
+        assert det not in ft._ACTIVE
+
+    def test_trainer_restore_resets_hang_clock(self, monkeypatch):
+        """maybe_resume's restore path must call notify_progress_reset
+        (wired via the module hook) — asserted through a started
+        detector whose clock predates the 'restore'."""
+        det = HangingDetector(timeout=0.2)
+        det.start()
+        try:
+            det._last_progress -= 10.0  # simulate a long restore
+            assert det.is_hanging()
+            from dlrover_tpu.trainer.fault_tolerance import (
+                notify_progress_reset,
+            )
+
+            notify_progress_reset("checkpoint-restore")
+            assert not det.is_hanging()
+        finally:
+            det.stop()
+
     def test_reports_to_master(self, local_master):
         from dlrover_tpu.agent.master_client import MasterClient
         from dlrover_tpu.common.constants import NodeType

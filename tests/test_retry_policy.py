@@ -1,0 +1,380 @@
+"""Unified retry/deadline policy + degraded-mode coverage: backoff
+bounds, total-deadline budgets, the RpcClient lock released during
+backoff sleeps, and non-critical clients (brain, paral tuner, stats)
+disabling themselves instead of crashing the trainer."""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common import retry
+from dlrover_tpu.common.retry import (
+    NonCriticalGuard,
+    RetryPolicy,
+    run_with_retry,
+)
+from dlrover_tpu.common.rpc import RpcClient, RpcServer, RpcService, \
+    find_free_port
+
+
+class _Echo(RpcService):
+    def get(self, node_type, node_id, message):
+        return message
+
+    def report(self, node_type, node_id, message):
+        return True
+
+
+@pytest.fixture
+def fast_policy():
+    return RetryPolicy(
+        max_attempts=3, base_delay=0.05, max_delay=0.1, deadline=5.0,
+        jitter=False,
+    )
+
+
+class TestRetryPolicy:
+    def test_backoff_no_jitter_is_exponential_capped(self):
+        p = RetryPolicy(base_delay=0.5, max_delay=5.0, jitter=False)
+        assert [p.backoff(i) for i in range(5)] == [
+            0.5, 1.0, 2.0, 4.0, 5.0,
+        ]
+
+    def test_backoff_full_jitter_bounds(self):
+        import random
+
+        p = RetryPolicy(base_delay=0.5, max_delay=5.0, jitter=True)
+        rng = random.Random(0)
+        for attempt in range(6):
+            cap = min(0.5 * 2 ** attempt, 5.0)
+            for _ in range(50):
+                d = p.backoff(attempt, rng)
+                assert 0.0 <= d <= cap
+
+    def test_run_with_retry_returns_first_success(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("flaky")
+            return "ok"
+
+        p = RetryPolicy(max_attempts=5, base_delay=0.01, jitter=False)
+        assert run_with_retry(fn, p) == "ok"
+        assert len(calls) == 3
+
+    def test_run_with_retry_deadline_caps_attempts(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        # huge attempt count, tiny budget: the deadline must win
+        p = RetryPolicy(
+            max_attempts=1000, base_delay=0.2, max_delay=0.2,
+            deadline=0.5, jitter=False,
+        )
+        start = time.monotonic()
+        with pytest.raises(ConnectionError, match="budget"):
+            run_with_retry(fn, p)
+        assert time.monotonic() - start < 2.0
+        assert len(calls) < 10
+
+    def test_on_failure_hook_runs_per_attempt(self):
+        drops = []
+        p = RetryPolicy(max_attempts=3, base_delay=0.01, jitter=False)
+        with pytest.raises(ConnectionError):
+            run_with_retry(
+                lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                p, on_failure=lambda e: drops.append(e),
+            )
+        assert len(drops) == 3
+
+    def test_default_policy_reads_env_once(self, monkeypatch):
+        monkeypatch.setenv(retry.ENV_MAX_ATTEMPTS, "9")
+        monkeypatch.setenv(retry.ENV_DEADLINE, "12.5")
+        monkeypatch.setenv(retry.ENV_JITTER, "0")
+        retry.set_default_rpc_policy(None)
+        try:
+            p = retry.default_rpc_policy()
+            assert p.max_attempts == 9
+            assert p.deadline == 12.5
+            assert not p.jitter
+            # cached: a later env change is invisible until reset
+            monkeypatch.setenv(retry.ENV_MAX_ATTEMPTS, "2")
+            assert retry.default_rpc_policy().max_attempts == 9
+        finally:
+            retry.set_default_rpc_policy(None)
+
+    def test_noncritical_policy_is_shorter(self):
+        retry.set_default_rpc_policy(None)
+        nc = retry.noncritical_rpc_policy()
+        base = retry.default_rpc_policy()
+        assert nc.max_attempts <= base.max_attempts
+        assert nc.deadline <= base.deadline
+        retry.set_default_rpc_policy(None)
+
+
+class TestRpcClientRetry:
+    def test_roundtrip_with_policy(self, fast_policy):
+        server = RpcServer(0, _Echo(), host="127.0.0.1")
+        server.start()
+        try:
+            client = RpcClient(
+                f"127.0.0.1:{server.port}", policy=fast_policy
+            )
+            assert client.get("worker", 0, {"k": 1}) == {"k": 1}
+            client.close()
+        finally:
+            server.stop()
+
+    def test_dead_master_fails_within_budget(self):
+        port = find_free_port("127.0.0.1")
+        client = RpcClient(
+            f"127.0.0.1:{port}",
+            policy=RetryPolicy(
+                max_attempts=50, base_delay=0.05, max_delay=0.1,
+                deadline=0.6, jitter=False,
+            ),
+        )
+        start = time.monotonic()
+        with pytest.raises(ConnectionError, match="budget"):
+            client.call("get", "worker", 0, None)
+        assert time.monotonic() - start < 3.0
+
+    def test_retries_override_wins(self):
+        port = find_free_port("127.0.0.1")
+        client = RpcClient(
+            f"127.0.0.1:{port}",
+            policy=RetryPolicy(max_attempts=50, base_delay=0.05,
+                               deadline=30.0, jitter=False),
+        )
+        start = time.monotonic()
+        with pytest.raises(ConnectionError, match="1 attempt"):
+            client.call("get", "worker", 0, None, retries=1)
+        assert time.monotonic() - start < 2.0
+
+    def test_lock_released_during_backoff_sleep(self):
+        """One dead master must not stall every caller thread: the
+        connection lock may be held only around the socket round-trip,
+        never across backoff sleeps."""
+        port = find_free_port("127.0.0.1")
+        client = RpcClient(
+            f"127.0.0.1:{port}",
+            policy=RetryPolicy(
+                max_attempts=3, base_delay=0.8, max_delay=0.8,
+                deadline=5.0, jitter=False,
+            ),
+        )
+        done = threading.Event()
+
+        def blocked_call():
+            try:
+                client.call("get", "worker", 0, None)
+            except ConnectionError:
+                pass
+            finally:
+                done.set()
+
+        t = threading.Thread(target=blocked_call, daemon=True)
+        t.start()
+        # attempt 1 fails ~instantly (refused); the thread is now in its
+        # 0.8s backoff sleep — the lock must be free
+        time.sleep(0.3)
+        acquired = client._lock.acquire(timeout=0.2)
+        if acquired:
+            client._lock.release()
+        assert acquired, "connection lock held across a backoff sleep"
+        assert done.wait(10)
+
+    def test_blackholed_master_respects_deadline_budget(self):
+        """A server that accepts but never answers must not pin the
+        caller for the full 30s socket timeout: the per-attempt socket
+        timeout is clamped to the policy's remaining deadline."""
+        import socket as _socket
+
+        srv = _socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        try:
+            client = RpcClient(
+                f"127.0.0.1:{srv.getsockname()[1]}",
+                timeout=30.0,
+                policy=RetryPolicy(
+                    max_attempts=2, base_delay=0.05, max_delay=0.1,
+                    deadline=1.0, jitter=False,
+                ),
+            )
+            start = time.monotonic()
+            with pytest.raises(ConnectionError):
+                client.call("get", "worker", 0, None)
+            # well under the 30s transport timeout; a little slack
+            # over the 1s budget for the second clamped attempt
+            assert time.monotonic() - start < 5.0
+            client.close()
+        finally:
+            srv.close()
+
+    def test_reconnects_after_transient_down(self, fast_policy):
+        """Server down for the first attempts, then up: the call must
+        ride the policy through reconnect instead of failing."""
+        port = find_free_port("127.0.0.1")
+        client = RpcClient(
+            f"127.0.0.1:{port}",
+            policy=RetryPolicy(
+                max_attempts=20, base_delay=0.1, max_delay=0.2,
+                deadline=10.0, jitter=False,
+            ),
+        )
+        server_box = {}
+
+        def bring_up():
+            time.sleep(0.5)
+            server = RpcServer(port, _Echo(), host="127.0.0.1")
+            server.start()
+            server_box["s"] = server
+
+        t = threading.Thread(target=bring_up, daemon=True)
+        t.start()
+        try:
+            assert client.get("worker", 0, {"x": 2}) == {"x": 2}
+        finally:
+            t.join()
+            client.close()
+            if "s" in server_box:
+                server_box["s"].stop()
+
+
+class TestDegradedMode:
+    def test_guard_disables_after_consecutive_failures(self):
+        guard = NonCriticalGuard("t", max_consecutive_failures=3)
+
+        def fail():
+            raise ConnectionError("down")
+
+        for _ in range(2):
+            assert guard.run(fail, default="d") == "d"
+        assert not guard.disabled
+        guard.run(fail)
+        assert guard.disabled
+        # disabled: returns default instantly, fn never called
+        assert guard.run(lambda: 1 / 0, default="d") == "d"
+
+    def test_guard_success_resets_failure_count(self):
+        guard = NonCriticalGuard("t", max_consecutive_failures=2)
+        guard.run(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+        assert guard.run(lambda: "ok") == "ok"
+        guard.run(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+        assert not guard.disabled  # counter was reset by the success
+
+    def test_brain_client_degrades_and_trainer_continues(self):
+        """A dead brain endpoint: after the budget is exhausted a few
+        times the client disables itself; later calls are instant
+        no-ops (metrics dropped), never exceptions."""
+        from dlrover_tpu.brain.client import BrainClient
+
+        retry.set_default_rpc_policy(RetryPolicy(
+            max_attempts=1, base_delay=0.01, deadline=0.5, jitter=False,
+        ))
+        try:
+            port = find_free_port("127.0.0.1")
+            client = BrainClient(f"127.0.0.1:{port}")
+            for _ in range(3):
+                assert client.persist_metrics("u", "j", {"s": 1}) is False
+            assert client.degraded
+            start = time.monotonic()
+            assert client.optimize("u", "j", "cold_create") is None
+            assert client.get_job_metrics("u") == []
+            assert time.monotonic() - start < 0.1  # no socket attempts
+            client.close()
+        finally:
+            retry.set_default_rpc_policy(None)
+
+    def test_paral_tuner_degrades_and_stops(self, tmp_path):
+        from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
+
+        class DeadClient:
+            def get_paral_config(self):
+                raise ConnectionError("master gone")
+
+        tuner = ParalConfigTuner(
+            client=DeadClient(),
+            config_path=str(tmp_path / "paral.json"),
+        )
+        for _ in range(3):
+            assert tuner.tune_once() is False
+        assert tuner.degraded
+
+    def test_guard_cooldown_reopens_after_partition_heals(self):
+        """Circuit breaker, not a kill switch: after the cooldown the
+        guard lets a probe through, and a success fully re-arms it."""
+        healthy = {"up": False}
+
+        def call():
+            if not healthy["up"]:
+                raise ConnectionError("partitioned")
+            return "ok"
+
+        guard = NonCriticalGuard(
+            "t", max_consecutive_failures=2, cooldown=0.1
+        )
+        guard.run(call)
+        guard.run(call)
+        assert guard.disabled
+        assert guard.run(call, default="d") == "d"  # still cooling
+        time.sleep(0.15)
+        healthy["up"] = True
+        assert guard.run(call) == "ok"  # half-open probe succeeds
+        assert not guard.disabled
+
+    def test_guard_failed_probe_retrips_immediately(self):
+        guard = NonCriticalGuard(
+            "t", max_consecutive_failures=3, cooldown=0.1
+        )
+
+        def fail():
+            raise ConnectionError("still down")
+
+        for _ in range(3):
+            guard.run(fail)
+        assert guard.disabled
+        time.sleep(0.15)
+        guard.run(fail)  # single half-open probe fails
+        assert guard.disabled  # re-tripped without 3 more failures
+
+    def test_resource_monitor_degrades_then_recovers(self):
+        """The stats loop must survive a degrade (no permanent exit —
+        permanently silent step reports could read as a job hang) and
+        resume reporting once the master is reachable again."""
+        from dlrover_tpu.agent.monitor import ResourceMonitor
+
+        state = {"up": False, "reports": 0}
+
+        class FlakyClient:
+            def report_used_resource(self, *a, **k):
+                if not state["up"]:
+                    raise ConnectionError("master gone")
+                state["reports"] += 1
+                return True
+
+        mon = ResourceMonitor(FlakyClient(), interval=0.02)
+        mon._guard._max = 2
+        mon._guard._cooldown = 0.1
+        mon.start()
+        try:
+            deadline = time.monotonic() + 5
+            while not mon._guard.disabled:
+                assert time.monotonic() < deadline, "never degraded"
+                time.sleep(0.02)
+            assert mon._thread.is_alive()  # loop survived the degrade
+            state["up"] = True
+            deadline = time.monotonic() + 5
+            while state["reports"] == 0:
+                assert time.monotonic() < deadline, "never recovered"
+                time.sleep(0.02)
+            assert not mon._guard.disabled
+        finally:
+            mon.stop()

@@ -1,0 +1,159 @@
+"""Replay a named chaos schedule against a tiny elastic job.
+
+Usage:
+    python tools/chaos_run.py --schedule worker-kill
+    python tools/chaos_run.py --schedule @/path/to/schedule.json
+    python tools/chaos_run.py --schedule '{"seed":7,"rules":[...]}'
+    python tools/chaos_run.py --list
+
+Spins up an in-process LocalJobMaster plus a one-node
+ElasticTrainingAgent whose worker trains a toy counter with flash
+checkpoints, with ``DLROVER_CHAOS`` armed from the requested schedule —
+the same harness tests/test_chaos_schedules.py asserts against, as a
+CLI for reproducing a fault pattern while debugging. Prints the job
+outcome, the worker's result record, and the chaos fire summary."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+WORKER = """
+import json, os
+import jax.numpy as jnp
+from dlrover_tpu.trainer.flash_checkpoint.engine import (
+    ReplicatedCheckpointEngine,
+)
+
+out_dir = os.environ["CHAOS_OUT_DIR"]
+total = int(os.environ.get("CHAOS_TOTAL_STEPS", "10"))
+engine = ReplicatedCheckpointEngine(out_dir + "/ckpt")
+restored = engine.load()
+if restored is None:
+    start, w = 0, jnp.zeros((4,))
+else:
+    start = int(restored["step"])
+    w = jnp.asarray(list(restored["state"].values())[0])
+
+for step in range(start + 1, total + 1):
+    w = w + 1.0
+    if step % 2 == 0:
+        # synchronous persist: an in-flight persist would hold the shm
+        # lock and make later saves skip (never reaching their fault
+        # site), which would turn a chaos replay into a silent no-op
+        engine.save_to_storage(step, {"w": w})
+        engine.wait_for_persist(step, timeout=60)
+    else:
+        engine.save_to_memory(step, {"w": w})
+
+with open(out_dir + "/result.json", "w") as f:
+    json.dump({
+        "resumed_from": start,
+        "final_step": total,
+        "w0": float(w[0]),
+    }, f)
+engine.close()
+"""
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--schedule",
+        help="named schedule, inline JSON, or @/path/to/schedule.json",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list named schedules"
+    )
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument(
+        "--out-dir", default="", help="work dir (default: a temp dir)"
+    )
+    parser.add_argument(
+        "--keep", action="store_true",
+        help="keep the work dir (logs, checkpoints) for inspection",
+    )
+    args = parser.parse_args()
+
+    # env must be armed BEFORE dlrover_tpu imports anywhere (the chaos
+    # module reads it once at import), and before jax picks a backend
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from dlrover_tpu.common import chaos
+
+    if args.list or not args.schedule:
+        print("named schedules:")
+        for name, sched in chaos.NAMED_SCHEDULES.items():
+            print(f"  {name}: {json.dumps(sched)}")
+        return 0
+
+    schedule = chaos.resolve_schedule(args.schedule)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="chaos_run_")
+    os.makedirs(out_dir, exist_ok=True)
+    os.environ["CHAOS_OUT_DIR"] = out_dir
+    os.environ["CHAOS_TOTAL_STEPS"] = str(args.steps)
+    os.environ["DLROVER_TPU_SOCKET_DIR"] = os.path.join(out_dir, "socks")
+    os.environ["ELASTIC_JOB_NAME"] = f"chaos_run_{os.getpid()}"
+    # the worker subprocess arms itself from this env; this (agent)
+    # process stays clean so master/agent control flow is unperturbed
+    # unless the schedule targets agent/master sites — then arm locally
+    os.environ[chaos.ENV_VAR] = json.dumps(schedule)
+    agent_sites = {"rpc.send", "rpc.recv", "rdzv.join", "agent.spawn"}
+    if any(r.get("site") in agent_sites for r in schedule.get("rules", [])):
+        chaos.install(schedule)
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.training_agent import (
+        ElasticLaunchConfig,
+        ElasticTrainingAgent,
+        WorkerSpec,
+    )
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.scheduler.job import new_job_args
+
+    master = LocalJobMaster(0, new_job_args("local", "chaos-run"))
+    master.prepare()
+    script = os.path.join(out_dir, "chaos_worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+    config = ElasticLaunchConfig(
+        min_nodes=1, max_nodes=1, nproc_per_node=1,
+        monitor_interval=0.3, rdzv_timeout=60, max_restarts=3,
+        log_dir=out_dir,
+    )
+    client = MasterClient(master.addr, 0, NodeType.WORKER)
+    agent = ElasticTrainingAgent(
+        config, WorkerSpec(script, (), config), client
+    )
+    try:
+        rc = agent.run()
+    finally:
+        client.close()
+        master.stop()
+
+    print(f"\nagent exit code: {rc}")
+    result_path = os.path.join(out_dir, "result.json")
+    if os.path.exists(result_path):
+        with open(result_path) as f:
+            print(f"worker result: {f.read()}")
+    else:
+        print("worker result: MISSING (job never completed)")
+    reg = chaos.active_registry()
+    if reg is not None:
+        print(f"agent-side chaos fires: {reg.summary()}")
+    print(f"work dir: {out_dir}" + ("" if args.keep else " (removing)"))
+    if not args.keep and not args.out_dir:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
