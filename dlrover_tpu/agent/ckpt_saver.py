@@ -29,6 +29,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.chaos import chaos_transform
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.ipc import (
@@ -415,6 +416,67 @@ def verify_step_dir(step_dir: str, deep: bool = True) -> tuple[bool, str]:
     return True, ""
 
 
+def list_step_numbers(checkpoint_dir: str) -> list[int]:
+    """Persisted step-dir numbers under ``checkpoint_dir``, newest
+    first. The ONE place that knows the dir-name/.tmp convention — the
+    engine's candidate scan and the agent's verified scan both build on
+    it, so the consensus report can never skew from what the restore
+    path will actually consider."""
+    prefix = CheckpointConstant.STEP_DIR_PREFIX
+    steps: set[int] = set()
+    try:
+        for name in os.listdir(checkpoint_dir):
+            if not name.startswith(prefix) or name.endswith(".tmp"):
+                continue
+            try:
+                steps.add(int(name[len(prefix):]))
+            except ValueError:
+                continue
+    except OSError:
+        pass
+    return sorted(steps, reverse=True)
+
+
+def verified_storage_steps(
+    checkpoint_dir: str, limit: int = 64
+) -> list[int]:
+    """The newest (up to ``limit``) persisted steps whose directories
+    pass the DEEP verify (payload CRCs included). This feeds the
+    master's restore-step consensus, and the restore path deep-verifies
+    its candidates — advertising on a shallower check would let a
+    bit-rotted step become the job-wide consensus, fail every restore,
+    and livelock the whole job in restart loops. The ``.verified``
+    marker caches full-CRC work per step dir, so only the first scan
+    after a persist pays the read.
+
+    ``limit`` bounds the scan; it sits far above any sane retention
+    policy (keep-latest-N), but a host that somehow retains more dirs
+    gets a LOUD log when truncation could hide a cross-host common
+    step from the consensus intersection — never a silent cap."""
+    prefix = CheckpointConstant.STEP_DIR_PREFIX
+    out: list[int] = []
+    steps = list_step_numbers(checkpoint_dir)
+    for step in steps:
+        if len(out) >= limit:
+            logger.warning(
+                "verified-step scan truncated at %d of %d step dirs "
+                "under %s: steps older than %d are not advertised for "
+                "restore consensus",
+                limit, len(steps), checkpoint_dir, out[-1],
+            )
+            break
+        step_dir = os.path.join(checkpoint_dir, f"{prefix}{step}")
+        ok, _reason = verify_step_dir(step_dir, deep=True)
+        if ok:
+            out.append(step)
+    return out
+
+
+def newest_verified_step(checkpoint_dir: str) -> int:
+    steps = verified_storage_steps(checkpoint_dir, limit=1)
+    return steps[0] if steps else -1
+
+
 def read_host_shard_meta(
     path: str,
 ) -> tuple[CheckpointMeta, int] | None:
@@ -733,11 +795,19 @@ class AsyncCheckpointSaver:
         finally:
             if acquired:
                 lock.release(force=True)
+        elapsed = time.time() - start
+        # timeline only: the daemon's persist overlaps training, so the
+        # goodput ledger deliberately does NOT treat it as lost time
+        telemetry.event(
+            "ckpt.persist", step=event.step, dur=elapsed,
+            shard=local_rank,
+        )
+        telemetry.observe("ckpt.persist.seconds", elapsed)
         logger.info(
             "persisted step %s shard %d in %.2fs",
             event.step,
             local_rank,
-            time.time() - start,
+            elapsed,
         )
 
     def _acquire_or_take_over(

@@ -115,7 +115,8 @@ class MasterClient:
     # ----------------------------------------------------------- rendezvous
 
     def join_rendezvous(
-        self, node_rank: int, local_world_size: int, rdzv_name: str
+        self, node_rank: int, local_world_size: int, rdzv_name: str,
+        verified_ckpt_step: int = -1, verified_ckpt_steps=None,
     ) -> bool:
         return self._report(
             msg.JoinRendezvousRequest(
@@ -124,6 +125,8 @@ class MasterClient:
                 local_world_size=local_world_size,
                 rdzv_name=rdzv_name,
                 node_ip=self._host_ip,
+                verified_ckpt_step=verified_ckpt_step,
+                verified_ckpt_steps=list(verified_ckpt_steps or ()),
             )
         )
 
@@ -198,6 +201,21 @@ class MasterClient:
             ),
             retries=1,
         )
+
+    def report_telemetry(self, snapshot: dict) -> bool:
+        """Ship a telemetry registry snapshot (cumulative, idempotent);
+        best-effort like the other stats reports."""
+        return self._report(
+            msg.TelemetrySnapshot(
+                node_id=self._node_id, payload=snapshot
+            ),
+            retries=1,
+        )
+
+    def get_telemetry_report(self) -> dict:
+        """The master's merged job view (goodput ledger + timeline)."""
+        res: msg.TelemetryReport = self._get(msg.TelemetryReportRequest())
+        return res.payload if res else {}
 
     def report_node_meta(
         self, node_rank: int, addr: str, tpu_chips: int = 0

@@ -186,6 +186,93 @@ class TrainingMetricsReporter:
             self._stopped.wait(self._interval)
 
 
+class TelemetryReporter:
+    """Ships telemetry snapshots to the master on a cadence: this
+    process's own registry, plus any snapshot files other processes of
+    this host (workers) flushed into ``DLROVER_TELEMETRY_DIR`` — the
+    workers have no control-plane client, so the agent is their relay.
+    Each tick also re-flushes the local snapshot so the on-disk copy
+    used by ``tools/obs_report.py --dir`` stays fresh.
+
+    Best-effort like the other stats reporters: a NonCriticalGuard
+    circuit breaker, never a training stall."""
+
+    # circuit breaker, not a kill switch: see ResourceMonitor
+    _MAX_MISSES = 20
+    _COOLDOWN = 300.0
+
+    def __init__(self, master_client, interval=JobConstant.MONITOR_INTERVAL):
+        self._client = master_client
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._guard = NonCriticalGuard(
+            "telemetry-reporter",
+            max_consecutive_failures=self._MAX_MISSES,
+            cooldown=self._COOLDOWN,
+        )
+        # source -> last shipped (mtime, size): only changed files go out
+        self._shipped: dict = {}
+
+    def start(self):
+        threading.Thread(
+            target=self._loop, name="telemetry-reporter", daemon=True
+        ).start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def report_once(self, swallow: bool = False):
+        from dlrover_tpu.common import telemetry
+
+        try:
+            telemetry.flush()
+            snap = telemetry.snapshot()
+            if snap is not None:
+                self._guard.run(
+                    lambda: self._client.report_telemetry(snap)
+                )
+            own = snap["source"] if snap else None
+            for path, source in self._snapshot_files(own):
+                try:
+                    stat = os.stat(path)
+                    stamp = (stat.st_mtime, stat.st_size)
+                    if self._shipped.get(source) == stamp:
+                        continue
+                    with open(path) as f:
+                        payload = json.load(f)
+                except (OSError, ValueError):
+                    continue  # torn write / vanished file: next tick
+                if self._guard.run(
+                    lambda p=payload: self._client.report_telemetry(p)
+                ):
+                    self._shipped[source] = stamp
+        except Exception:  # noqa: BLE001 - relaying telemetry must
+            # never take the agent down — but a silently dead reporter
+            # would contradict this layer's whole purpose, so say so
+            logger.warning(
+                "telemetry report tick failed", exc_info=True
+            )
+            if not swallow:
+                raise
+
+    @staticmethod
+    def _snapshot_files(own_source):
+        from dlrover_tpu.common import telemetry
+
+        out_dir = os.environ.get(telemetry.ENV_DIR, "")
+        if not out_dir:
+            return
+        for path, source in telemetry.snapshot_files(out_dir):
+            if own_source is not None and source == own_source:
+                continue  # already shipped straight from memory
+            yield path, source
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            self.report_once(swallow=True)
+            self._stopped.wait(self._interval)
+
+
 class TimerRingExporter:
     """Drains the shared timing ring and exports per-tag aggregates —
     the out-of-process half of the xpu_timer capability (reference

@@ -25,10 +25,12 @@ import sys
 import time
 
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.agent.monitor import (
     HeartbeatReporter,
     ResourceMonitor,
+    TelemetryReporter,
     TimerRingExporter,
 )
 from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
@@ -130,17 +132,45 @@ class MasterRendezvousHandler:
         client: MasterClient,
         local_world_size: int,
         timeout: float,
+        verified_step_fn=None,
     ):
         self._name = name
         self._node_rank = node_rank
         self._client = client
         self._local_world_size = local_world_size
         self._timeout = timeout
+        # callable -> list of locally-restorable checkpoint steps,
+        # reported at join for the master's restore consensus (the
+        # master forces only a step common to EVERY member)
+        self._verified_step_fn = verified_step_fn
+        # consensus the master broadcast with the latest formed world
+        self.last_restore_step = -1
+
+    def _local_verified_steps(self) -> list[int]:
+        if self._verified_step_fn is None:
+            return []
+        try:
+            return sorted(
+                {int(s) for s in self._verified_step_fn() if int(s) >= 0},
+                reverse=True,
+            )
+        except Exception:  # noqa: BLE001 - reporting steps is best-
+            # effort; a scan error must not block the rendezvous
+            logger.warning(
+                "verified-step scan failed; joining without one",
+                exc_info=True,
+            )
+            return []
 
     def next_rendezvous(self):
         """Returns (round, world, rank_offset, total_world, coordinator)."""
+        t0 = time.monotonic()
+        verified_steps = self._local_verified_steps()
+        newest = verified_steps[0] if verified_steps else -1
         joined = self._client.join_rendezvous(
-            self._node_rank, self._local_world_size, self._name
+            self._node_rank, self._local_world_size, self._name,
+            verified_ckpt_step=newest,
+            verified_ckpt_steps=verified_steps,
         )
         start = time.time()
         while True:
@@ -150,7 +180,9 @@ class MasterRendezvousHandler:
                 # recorded as waiting, so re-send the join or this node
                 # polls an empty world until the timeout
                 joined = self._client.join_rendezvous(
-                    self._node_rank, self._local_world_size, self._name
+                    self._node_rank, self._local_world_size, self._name,
+                    verified_ckpt_step=newest,
+                    verified_ckpt_steps=verified_steps,
                 )
             world = self._client.get_comm_world(self._name, self._node_rank)
             if world and world.world and self._node_rank in world.world:
@@ -180,6 +212,15 @@ class MasterRendezvousHandler:
         )
 
         notify_progress_reset("rendezvous-resume")
+        self.last_restore_step = getattr(world, "restore_step", -1)
+        telemetry.event(
+            "rdzv.wait",
+            dur=time.monotonic() - t0,
+            name=self._name,
+            round=world.round,
+            world=len(world.world),
+            restore_step=self.last_restore_step,
+        )
         return world.round, world.world, rank_offset, total, world.coordinator_addr
 
 
@@ -243,9 +284,11 @@ class ElasticTrainingAgent:
             client,
             config.nproc_per_node,
             config.rdzv_timeout,
+            verified_step_fn=self._restorable_steps,
         )
         self._heartbeat = HeartbeatReporter(client)
         self._resource_monitor = ResourceMonitor(client)
+        self._telemetry_reporter = TelemetryReporter(client)
         self._paral_tuner = ParalConfigTuner(client) \
             if config.auto_tunning else None
         self._timer_exporter = TimerRingExporter()
@@ -254,16 +297,53 @@ class ElasticTrainingAgent:
 
     # ----------------------------------------------------------- lifecycle
 
+    def _restorable_steps(self) -> list[int]:
+        """The checkpoint steps this host could restore right now:
+        verified storage steps, plus the shm step — but the latter only
+        on single-host jobs, because a multi-host sharded engine dedups
+        replicated leaves to one writer and a host's shm may then be
+        target-incomplete (its restore path would refuse it), so
+        advertising it could broadcast a consensus step some host
+        cannot actually load. Reported at rendezvous join; the master
+        forces the newest step common to every member."""
+        from dlrover_tpu.agent.ckpt_saver import (
+            AsyncCheckpointSaver,
+            SharedMemoryHandler,
+            verified_storage_steps,
+        )
+
+        saver = self._ckpt_saver or AsyncCheckpointSaver.get_ckpt_saver()
+        if saver is None:
+            return []
+        steps: set[int] = set()
+        if saver.num_hosts <= 1:
+            for local_rank in range(saver.local_shard_num):
+                # throwaway handler: the saver's own handlers may be in
+                # use by a concurrent persist thread
+                handler = SharedMemoryHandler(local_rank)
+                try:
+                    if handler.attach():
+                        step = handler.get_checkpoint_step()
+                        if step >= 0:
+                            steps.add(step)
+                finally:
+                    handler.close()
+        if saver.checkpoint_dir:
+            steps.update(verified_storage_steps(saver.checkpoint_dir))
+        return sorted(steps, reverse=True)
+
     def _initialize_workers(self):
         rdzv_round, world, rank_offset, total, coordinator = (
             self._rdzv_handler.next_rendezvous()
         )
         logger.info(
-            "rendezvous round %s: world=%s rank_offset=%s total=%s",
+            "rendezvous round %s: world=%s rank_offset=%s total=%s "
+            "restore_step=%s",
             rdzv_round,
             world,
             rank_offset,
             total,
+            self._rdzv_handler.last_restore_step,
         )
         self._start_worker_processes(rank_offset, total, coordinator)
 
@@ -304,6 +384,16 @@ class ElasticTrainingAgent:
                 ConfigPath.ENV_RUNTIME_METRICS: ConfigPath.RUNTIME_METRICS,
             }
         )
+        # Telemetry: workers label their snapshots as role=worker (the
+        # goodput ledger keys incarnation gaps off it), and the
+        # master-brokered consensus restore step rides the env so the
+        # engine restores exactly the agreed step.
+        env[telemetry.ENV_ROLE] = "worker"
+        restore_step = self._rdzv_handler.last_restore_step
+        if restore_step >= 0:
+            env[NodeEnv.RESTORE_STEP] = str(restore_step)
+        else:
+            env.pop(NodeEnv.RESTORE_STEP, None)
         apply_compilation_cache_env(
             self._config.compilation_cache_dir, env
         )
@@ -314,6 +404,12 @@ class ElasticTrainingAgent:
             "agent.spawn",
             restart=self._restart_count,
             rank_offset=rank_offset,
+        )
+        telemetry.event(
+            "worker.spawn",
+            restart=self._restart_count,
+            rank_offset=rank_offset,
+            total=total,
         )
         self._workers = []
         self._log_files = []
@@ -429,6 +525,7 @@ class ElasticTrainingAgent:
             pass  # not the main thread (tests)
         self._heartbeat.start()
         self._resource_monitor.start()
+        self._telemetry_reporter.start()
         self._timer_exporter.start()
         if self._config.metrics_port >= 0:
             from dlrover_tpu.agent.monitor import MetricsEndpoint
@@ -452,11 +549,17 @@ class ElasticTrainingAgent:
             self._stop_workers()
             self._heartbeat.stop()
             self._resource_monitor.stop()
+            self._telemetry_reporter.stop()
             self._timer_exporter.stop()
             if self._metrics_endpoint is not None:
                 self._metrics_endpoint.stop()
             if self._paral_tuner is not None:
                 self._paral_tuner.stop()
+            # final best-effort publish: the post-run obs report (and
+            # the master, while it still listens) must see the agent's
+            # rendezvous/spawn tail even after an abrupt job end
+            self._telemetry_reporter.report_once(swallow=True)
+            telemetry.flush()
 
     def _job_name(self) -> str:
         return os.environ.get(NodeEnv.JOB_NAME) or "job_" + (
@@ -482,6 +585,10 @@ class ElasticTrainingAgent:
                 idx, code = failed[0]
                 tail = self._log_tail(idx)
                 kind = classify_exit(code, tail)
+                telemetry.event(
+                    "worker.exit", local_rank=idx, rc=code,
+                    exit_kind=kind, restart=self._restart_count,
+                )
                 logger.warning(
                     "worker %d exited rc=%s (%s)", idx, code, kind
                 )

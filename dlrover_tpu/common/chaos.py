@@ -57,6 +57,7 @@ import threading
 import time
 from collections import deque
 
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -153,6 +154,13 @@ class ChaosRule:
                 "chaos[kill] at %s (ctx=%s): exiting %d",
                 site, ctx, self.exit_code,
             )
+            try:
+                # os._exit skips atexit: persist the telemetry snapshot
+                # NOW or the kill (and everything before it) vanishes
+                # from the merged timeline
+                telemetry.flush()
+            except Exception:  # noqa: BLE001 - dying anyway
+                pass
             os._exit(self.exit_code)
 
     def apply_transform(self, data, site: str, ctx: dict):
@@ -212,6 +220,13 @@ class ChaosRegistry:
                     self.fired.append((site, rule.action, dict(ctx)))
                     key = f"{site}:{rule.action}"
                     self._counts[key] = self._counts.get(key, 0) + 1
+                    telemetry.event(
+                        "chaos.fire", site=site, action=rule.action,
+                        step=ctx.get("step"),
+                    )
+                    telemetry.counter_inc(
+                        "chaos.fires", site=site, action=rule.action
+                    )
                     out.append(rule)
             return out
 

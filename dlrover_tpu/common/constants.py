@@ -173,6 +173,11 @@ class NodeEnv:
     WORLD_SIZE = "WORLD_SIZE"
     LOCAL_WORLD_SIZE = "LOCAL_WORLD_SIZE"
     GROUP_RANK = "GROUP_RANK"
+    # Master-brokered restore-step consensus (the newest checkpoint
+    # step restorable on every member of the rendezvous round): when
+    # set, checkpoint engines restore exactly this step instead of
+    # their local newest.
+    RESTORE_STEP = "DLROVER_TPU_RESTORE_STEP"
     RESTART_COUNT = "TORCHELASTIC_RESTARTS"
 
 

@@ -110,6 +110,13 @@ class JoinRendezvousRequest(Message):
     local_world_size: int = 1
     rdzv_name: str = ""
     node_ip: str = ""
+    # newest locally-restorable checkpoint step (-1 = none) and the
+    # full set of restorable steps this host could load right now. The
+    # master broadcasts the NEWEST step common to every member of the
+    # formed round — a step some host lacks must never be forced, or
+    # that host silently restores something older and the world splits.
+    verified_ckpt_step: int = -1
+    verified_ckpt_steps: list = field(default_factory=list)
 
 
 @dataclass
@@ -138,6 +145,10 @@ class CommWorld(Message):
     group: int = 0
     world: dict = field(default_factory=dict)
     coordinator_addr: str = ""
+    # master-brokered restore-step consensus: the NEWEST checkpoint
+    # step restorable on every member of the round (-1 = no forcing:
+    # some member reported nothing, or no common step exists)
+    restore_step: int = -1
 
 
 @dataclass
@@ -470,3 +481,31 @@ class DiagnosisReport(Message):
     node_id: int = 0
     content: str = ""
     tag: str = ""
+
+
+# --------------------------------------------------------------------------
+# telemetry (metrics registry snapshots + job-wide report)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class TelemetrySnapshot(Message):
+    """One process's cumulative telemetry registry snapshot (see
+    common/telemetry.py). Keyed by payload["source"]; re-sends are
+    idempotent on the master side."""
+
+    node_id: int = 0
+    payload: dict = field(default_factory=dict)
+
+
+@dataclass
+class TelemetryReportRequest(Message):
+    pass
+
+
+@dataclass
+class TelemetryReport(Message):
+    """Job-wide merged view: goodput ledger, event timeline, metrics
+    rollup, and the raw per-source snapshots (for client-side merges)."""
+
+    payload: dict = field(default_factory=dict)

@@ -22,6 +22,7 @@ import os
 import random
 import time
 
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -67,10 +68,14 @@ def run_with_retry(
     retry_on: tuple = (ConnectionError, OSError),
     on_failure=None,
     describe: str = "call",
+    op: str = "call",
 ):
     """Run ``fn`` under ``policy``. ``on_failure`` runs after each failed
     attempt (e.g. drop a dead connection). Raises the last error wrapped
-    in ConnectionError once attempts or the deadline budget run out."""
+    in ConnectionError once attempts or the deadline budget run out.
+
+    ``op`` is the bounded-cardinality telemetry label (``describe`` may
+    embed addresses and must stay out of metric labels)."""
     start = time.monotonic()
     last_err: Exception | None = None
     attempts = max(policy.max_attempts, 1)
@@ -86,8 +91,17 @@ def run_with_retry(
             return fn()
         except retry_on as e:
             last_err = e
+            telemetry.counter_inc("retry.attempt_failed", op=op)
             if on_failure is not None:
                 on_failure(e)
+    telemetry.counter_inc("retry.exhausted", op=op)
+    telemetry.event(
+        "retry.exhausted",
+        op=op,
+        attempts=made,
+        dur_budget=policy.deadline,
+        error=f"{type(last_err).__name__}: {last_err}"[:200],
+    )
     raise ConnectionError(
         f"{describe} failed after {made} attempt(s) in "
         f"{time.monotonic() - start:.1f}s "
@@ -161,6 +175,10 @@ class NonCriticalGuard:
         self._failures = 0
         self._cooldown = cooldown
         self._reopen_at = 0.0
+        # set while the guard has tripped at least once and not yet
+        # recovered: a later success is a degrade->recover transition
+        # worth surfacing, not business as usual
+        self._tripped = False
 
     def run(self, fn, default=None):
         if self.disabled:
@@ -179,8 +197,21 @@ class NonCriticalGuard:
             self._failures += 1
             if self._failures >= self._max:
                 self.disabled = True
+                self._tripped = True
                 if self._cooldown is not None:
                     self._reopen_at = time.monotonic() + self._cooldown
+                # a silently-degraded subsystem must be VISIBLE in the
+                # job report, not just a log line scrolled past
+                telemetry.event(
+                    "guard.degrade",
+                    name=self.name,
+                    failures=self._failures,
+                    cooldown=self._cooldown or 0.0,
+                )
+                telemetry.counter_inc("guard.degrades", name=self.name)
+                telemetry.gauge_set(
+                    "guard.degraded", 1.0, name=self.name
+                )
                 logger.warning(
                     "%s: disabled after %d consecutive failures "
                     "(degraded mode; training continues%s): %s",
@@ -195,8 +226,14 @@ class NonCriticalGuard:
                 )
             return default
         self._failures = 0
+        if self._tripped:
+            self._tripped = False
+            telemetry.event("guard.recover", name=self.name)
+            telemetry.gauge_set("guard.degraded", 0.0, name=self.name)
+            logger.info("%s: recovered; re-armed", self.name)
         return result
 
     def reset(self):
         self.disabled = False
         self._failures = 0
+        self._tripped = False

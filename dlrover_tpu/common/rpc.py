@@ -21,6 +21,7 @@ import socketserver
 import threading
 import time
 
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.framing import (
     recv_frame as _recv_frame,
@@ -243,12 +244,22 @@ class RpcClient:
             with self._lock:
                 self._close_nolock()
 
-        return run_with_retry(
+        result = run_with_retry(
             _attempt,
             policy,
             on_failure=_drop_connection,
             describe=f"rpc to {self._addr}",
+            op="rpc",
         )
+        # per-method latency, retries included: what the CALLER actually
+        # waited (msg-type cardinality is the closed wire-protocol set)
+        telemetry.observe(
+            "rpc.client.seconds",
+            time.monotonic() - start,
+            verb=verb,
+            msg=msg_type,
+        )
+        return result
 
     def get(
         self, node_type: str, node_id: int, message,

@@ -1,0 +1,648 @@
+"""Unified in-process telemetry: metrics registry + event timeline +
+goodput accounting.
+
+Equivalent capability: the reference gets operator-facing observability
+from two stacks — the brain's metric collectors (dlrover/python/master/
+stats) feeding its optimization algorithms, and the xpu_timer shm ring ->
+Prometheus export for per-kernel timing. Our reproduction had fragments
+of both (trainer/profiler.py XPlane traces, agent/monitor.py resource
+samples, master/stats.py runtime history) but no shared registry, no
+cross-layer event timeline, and no way to answer "what fraction of
+wall-clock was productive training vs. rendezvous/restart/checkpoint
+stalls". This module is that shared layer:
+
+- **Metrics registry**: counters, gauges, histograms with fixed bucket
+  boundaries (Prometheus ``le`` convention), thread-safe, dependency-free.
+- **Event timeline**: ``event(kind, **fields)`` appends a monotonic- and
+  wall-timestamped record to a bounded ring; events with a ``dur`` field
+  double as attributed wall-clock intervals.
+- **Snapshots**: each process serializes its registry to JSON
+  (cumulative, idempotent to re-merge); agents ship snapshots to the
+  master over the existing RPC path, and/or flush them to
+  ``DLROVER_TELEMETRY_DIR`` so they survive the process.
+- **Goodput ledger**: :func:`goodput_ledger` sweeps the merged timeline
+  and attributes every second of job wall-clock to one of
+  ``{productive, compile, checkpoint, restart, rendezvous, idle}``.
+  Categories sum to the total span by construction (idle is the
+  uncovered remainder; overlaps resolve by fixed priority).
+
+No-op contract (mirrors :mod:`dlrover_tpu.common.chaos`): when disabled
+(``DLROVER_TELEMETRY=0``, read ONCE at import) every module-level hook is
+a module-global load plus an ``is None`` branch — no locks, no dict work,
+no registry machinery. Enabled (the default), the cost per hook is one
+lock + one dict update, on paths already dominated by socket/disk/device
+IO.
+
+Reserved event fields: ``seq``, ``t`` (wall clock, merge ordering),
+``mono`` (monotonic, in-process durations), ``kind``, ``dur`` (seconds;
+makes the event an attributable interval ``[t - dur, t]``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import bisect
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+ENV_VAR = "DLROVER_TELEMETRY"        # "0"/"false"/"off" disables
+ENV_DIR = "DLROVER_TELEMETRY_DIR"    # set => flush() writes snapshots here
+ENV_ROLE = "DLROVER_TELEMETRY_ROLE"  # worker | agent | master (labeling)
+
+SNAPSHOT_FORMAT = 1
+MAX_EVENTS = 4096
+
+# Latency-shaped defaults: sub-ms RPCs through multi-minute restores.
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def _key(name: str, labels: dict) -> tuple:
+    return (name, tuple(sorted(labels.items())))
+
+
+# the ONE place that knows the snapshot-file naming convention — flush,
+# the agent's relay, and from_dir all build on these two helpers, so a
+# rename can never silently decouple writers from readers
+_SNAPSHOT_PREFIX = "telemetry_"
+_SNAPSHOT_SUFFIX = ".json"
+
+
+def snapshot_filename(source: str) -> str:
+    return f"{_SNAPSHOT_PREFIX}{source}{_SNAPSHOT_SUFFIX}"
+
+
+def snapshot_files(path: str):
+    """Yield ``(file_path, source)`` for every snapshot file in a
+    telemetry directory (empty when the dir is absent)."""
+    try:
+        names = sorted(os.listdir(path))
+    except OSError:
+        return
+    for name in names:
+        if not (
+            name.startswith(_SNAPSHOT_PREFIX)
+            and name.endswith(_SNAPSHOT_SUFFIX)
+        ):
+            continue
+        source = name[len(_SNAPSHOT_PREFIX):-len(_SNAPSHOT_SUFFIX)]
+        yield os.path.join(path, name), source
+
+
+class _Histogram:
+    """Fixed-boundary histogram. Bucket ``i`` counts observations with
+    ``value <= bounds[i]`` (Prometheus ``le``); the last bucket is +Inf."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds):
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError(f"bucket bounds must be sorted unique: {bounds}")
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float):
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+
+class TelemetryRegistry:
+    """One per process. All hooks funnel here; ``snapshot()`` serializes
+    the whole state (cumulative — re-merging the same snapshot is
+    idempotent on the receiving side)."""
+
+    def __init__(self, source: str | None = None):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = {}
+        self._gauges: dict[tuple, float] = {}
+        self._hists: dict[tuple, _Histogram] = {}
+        self._events: deque = deque(maxlen=MAX_EVENTS)
+        self._dropped = 0
+        self._seq = 0
+        self.created = time.time()
+        self.created_mono = time.monotonic()
+        self.role = os.environ.get(ENV_ROLE, "proc")
+        rank = os.environ.get("RANK") or os.environ.get("NODE_RANK") or "0"
+        self.source = source or f"{self.role}-{rank}-{os.getpid()}"
+
+    # ------------------------------------------------------------- metrics
+
+    def counter_inc(self, name: str, value: float = 1.0, /, **labels):
+        key = _key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def gauge_set(self, name: str, value: float, /, **labels):
+        with self._lock:
+            self._gauges[_key(name, labels)] = float(value)
+
+    def observe(self, name: str, value: float, /, buckets=None, **labels):
+        key = _key(name, labels)
+        with self._lock:
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = _Histogram(
+                    buckets or DEFAULT_BUCKETS
+                )
+            hist.observe(float(value))
+
+    # ------------------------------------------------------------ timeline
+
+    def event(self, kind: str, /, **fields):
+        with self._lock:
+            self._seq += 1
+            if len(self._events) == MAX_EVENTS:
+                self._dropped += 1
+            self._events.append({
+                "seq": self._seq,
+                "t": time.time(),
+                "mono": time.monotonic(),
+                "kind": kind,
+                **fields,
+            })
+
+    # ------------------------------------------------------------ snapshot
+
+    @staticmethod
+    def _metric_list(d: dict) -> list:
+        return [
+            {"name": name, "labels": dict(labels), "value": value}
+            for (name, labels), value in sorted(d.items())
+        ]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "format": SNAPSHOT_FORMAT,
+                "source": self.source,
+                "role": self.role,
+                "pid": os.getpid(),
+                "created": self.created,
+                "now": time.time(),
+                "counters": self._metric_list(self._counters),
+                "gauges": self._metric_list(self._gauges),
+                "histograms": [
+                    {
+                        "name": name,
+                        "labels": dict(labels),
+                        "bounds": list(h.bounds),
+                        "counts": list(h.counts),
+                        "sum": h.sum,
+                        "count": h.count,
+                    }
+                    for (name, labels), h in sorted(self._hists.items())
+                ],
+                "events": [dict(e) for e in self._events],
+                # no silent truncation: the ring is bounded, and a merge
+                # must be able to tell "quiet" from "overwrote the tail"
+                "events_dropped": self._dropped,
+            }
+
+    def flush(self, path: str | None = None) -> str | None:
+        """Write the snapshot JSON atomically. Default destination is
+        ``$DLROVER_TELEMETRY_DIR/telemetry_<source>.json``; without a
+        directory (and no explicit path) this is a no-op — the registry
+        stays purely in-memory."""
+        if path is None:
+            out_dir = os.environ.get(ENV_DIR, "")
+            if not out_dir:
+                return None
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir, snapshot_filename(self.source))
+        snap = self.snapshot()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("telemetry flush to %s failed: %s", path, e)
+            return None
+        return path
+
+
+# -------------------------------------------------------------------------
+# module-global arming (the chaos-style no-op pattern)
+# -------------------------------------------------------------------------
+
+_REGISTRY: TelemetryRegistry | None = None
+
+
+def counter_inc(name: str, value: float = 1.0, /, **labels):
+    reg = _REGISTRY
+    if reg is None:
+        return
+    reg.counter_inc(name, value, **labels)
+
+
+def gauge_set(name: str, value: float, /, **labels):
+    reg = _REGISTRY
+    if reg is None:
+        return
+    reg.gauge_set(name, value, **labels)
+
+
+def observe(name: str, value: float, /, buckets=None, **labels):
+    reg = _REGISTRY
+    if reg is None:
+        return
+    reg.observe(name, value, buckets, **labels)
+
+
+def event(kind: str, /, **fields):
+    reg = _REGISTRY
+    if reg is None:
+        return
+    reg.event(kind, **fields)
+
+
+def snapshot() -> dict | None:
+    reg = _REGISTRY
+    if reg is None:
+        return None
+    return reg.snapshot()
+
+
+def flush(path: str | None = None) -> str | None:
+    """Persist this process's snapshot (no-op when disabled or when no
+    ``DLROVER_TELEMETRY_DIR``/path is configured). Crash-path callers
+    (e.g. a chaos ``kill``) invoke this right before ``os._exit``."""
+    reg = _REGISTRY
+    if reg is None:
+        return None
+    return reg.flush(path)
+
+
+def active_registry() -> TelemetryRegistry | None:
+    return _REGISTRY
+
+
+def enable(source: str | None = None) -> TelemetryRegistry:
+    """(Re-)arm a fresh registry in this process (tests/tools)."""
+    global _REGISTRY
+    _REGISTRY = TelemetryRegistry(source)
+    return _REGISTRY
+
+
+def disable():
+    global _REGISTRY
+    _REGISTRY = None
+
+
+def install_from_env() -> TelemetryRegistry | None:
+    """One env read, at import time — never in the hot path. Telemetry is
+    ON by default (pure in-memory, bounded); ``DLROVER_TELEMETRY=0``
+    turns every hook into a global-load + is-None branch."""
+    if os.environ.get(ENV_VAR, "1").strip().lower() in (
+        "0", "false", "off", "no",
+    ):
+        disable()
+        return None
+    return enable()
+
+
+# -------------------------------------------------------------------------
+# goodput accounting
+# -------------------------------------------------------------------------
+
+CATEGORIES = (
+    "productive", "compile", "checkpoint", "restart", "rendezvous", "idle",
+)
+
+# kind -> ledger category, for events that carry a ``dur`` interval.
+# NOTE ckpt.persist (the agent daemon's async shm->storage copy) is
+# deliberately absent: it overlaps training and costs no goodput; only
+# the trainer-side save pause (ckpt.save) and the blocking end-of-run
+# persist wait (ckpt.persist.wait) do.
+EVENT_CATEGORY = {
+    "step.end": "productive",
+    "compile": "compile",
+    "ckpt.save": "checkpoint",
+    "ckpt.persist.wait": "checkpoint",
+    "ckpt.restore": "restart",
+    "rdzv.wait": "rendezvous",
+}
+
+# overlap resolution, highest first (a checkpoint pause inside a step
+# window counts as checkpoint only if the step didn't claim it; the
+# agent's rendezvous wait must show through the coarse dead-worker
+# restart gap it sits inside)
+_PRIORITY = ("productive", "compile", "checkpoint", "rendezvous", "restart")
+
+
+def _interval_events(snap: dict):
+    for ev in snap.get("events", ()):
+        cat = EVENT_CATEGORY.get(ev.get("kind"))
+        dur = ev.get("dur")
+        if cat is None or not dur or dur <= 0:
+            continue
+        t = float(ev["t"])
+        yield (t - float(dur), t, cat)
+
+
+def goodput_ledger(snapshots, now: float | None = None) -> dict:
+    """Attribute job wall-clock to goodput categories.
+
+    The span runs from the earliest event interval start to the latest
+    event time (or ``now`` when given, for live jobs). Gaps between
+    successive *worker* incarnations (kill -> next worker process) are
+    attributed to ``restart`` unless a higher-priority interval (e.g.
+    the agent's ``rdzv.wait``) covers them. A single sweep resolves
+    overlaps by fixed priority, so the categories sum to the span
+    exactly.
+
+    Multi-node note: the sweep collapses concurrent nodes onto one
+    timeline (a utilization view — "was ANYONE productive"); per-node
+    ledgers come from calling this with one node's snapshots.
+    """
+    intervals: list[tuple[float, float, str]] = []
+    tmin = tmax = None
+    worker_ranges = []
+    for snap in snapshots:
+        events = snap.get("events") or []
+        times = [float(e["t"]) for e in events]
+        if times:
+            lo, hi = min(times), max(times)
+            tmin = lo if tmin is None else min(tmin, lo)
+            tmax = hi if tmax is None else max(tmax, hi)
+            if snap.get("role") == "worker":
+                worker_ranges.append((lo, hi))
+        for iv in _interval_events(snap):
+            intervals.append(iv)
+            tmin = iv[0] if tmin is None else min(tmin, iv[0])
+    if tmin is None:
+        return {
+            "start": 0.0, "end": 0.0, "total_s": 0.0,
+            "categories": {c: 0.0 for c in CATEGORIES},
+            "goodput": 0.0,
+        }
+    end = max(tmax, now) if now is not None else tmax
+    # dead-worker gaps: between one worker incarnation's last activity
+    # and the next incarnation's first — restart time, unless something
+    # more specific (rendezvous) claims part of it
+    worker_ranges.sort()
+    for (prev_lo, prev_hi), (next_lo, _next_hi) in zip(
+        worker_ranges, worker_ranges[1:]
+    ):
+        if next_lo > prev_hi:
+            intervals.append((prev_hi, next_lo, "restart"))
+
+    totals = _sweep(intervals, tmin, end)
+    total = end - tmin
+    return {
+        "start": tmin,
+        "end": end,
+        "total_s": total,
+        "categories": totals,
+        "goodput": (totals["productive"] / total) if total > 0 else 0.0,
+    }
+
+
+def _sweep(intervals, lo: float, hi: float) -> dict:
+    """Boundary sweep: each instant gets its highest-priority active
+    category (idle when none). O(n log n); exact partition of [lo, hi]."""
+    totals = {c: 0.0 for c in CATEGORIES}
+    if hi <= lo:
+        return totals
+    deltas: dict[float, dict[str, int]] = {}
+    for start, end, cat in intervals:
+        start, end = max(start, lo), min(end, hi)
+        if end <= start:
+            continue
+        deltas.setdefault(start, {}).setdefault(cat, 0)
+        deltas[start][cat] += 1
+        deltas.setdefault(end, {}).setdefault(cat, 0)
+        deltas[end][cat] -= 1
+    active = {c: 0 for c in _PRIORITY}
+    prev = lo
+    for t in sorted(deltas):
+        if t > prev:
+            cat = next(
+                (c for c in _PRIORITY if active.get(c, 0) > 0), "idle"
+            )
+            totals[cat] += t - prev
+            prev = t
+        for cat, d in deltas[t].items():
+            active[cat] = active.get(cat, 0) + d
+    if hi > prev:
+        cat = next((c for c in _PRIORITY if active.get(c, 0) > 0), "idle")
+        totals[cat] += hi - prev
+    return totals
+
+
+# -------------------------------------------------------------------------
+# master-side merge (the job-wide view)
+# -------------------------------------------------------------------------
+
+
+class JobTelemetry:
+    """Merges per-process snapshots into a job-wide timeline + ledger.
+
+    Lives in the master servicer (fed by ``TelemetrySnapshot`` reports)
+    and in ``tools/obs_report.py`` (fed by snapshot files). Merging is
+    idempotent: snapshots are cumulative and keyed by source, and a
+    re-registered agent re-sending an old snapshot can never roll a
+    newer one back."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snaps: dict[str, dict] = {}
+
+    def update(self, snap) -> bool:
+        if not isinstance(snap, dict) or not snap.get("source"):
+            return False
+        source = str(snap["source"])
+        with self._lock:
+            existing = self._snaps.get(source)
+            if existing is not None and existing.get("now", 0.0) > snap.get(
+                "now", 0.0
+            ):
+                return False  # stale re-send (agent re-registration)
+            self._snaps[source] = snap
+            return True
+
+    def snapshots(self) -> list[dict]:
+        with self._lock:
+            return list(self._snaps.values())
+
+    def merged_events(self, snaps=None) -> list[dict]:
+        """All sources' events, source-tagged, wall-clock ordered."""
+        out = []
+        for snap in snaps if snaps is not None else self.snapshots():
+            for ev in snap.get("events", ()):
+                tagged = dict(ev)
+                tagged["source"] = snap["source"]
+                out.append(tagged)
+        out.sort(key=lambda e: (e.get("t", 0.0), e.get("seq", 0)))
+        return out
+
+    def ledger(self, now: float | None = None) -> dict:
+        return goodput_ledger(self.snapshots(), now=now)
+
+    def metrics_rollup(self, snaps=None) -> dict:
+        """Counters summed across sources; gauges latest-source-wins;
+        histograms merged bucket-wise (matching bounds)."""
+        counters: dict[tuple, float] = {}
+        gauges: dict[tuple, tuple[float, float]] = {}  # key -> (now, v)
+        hists: dict[tuple, dict] = {}
+        for snap in snaps if snaps is not None else self.snapshots():
+            snap_now = snap.get("now", 0.0)
+            for c in snap.get("counters", ()):
+                key = _key(c["name"], c["labels"])
+                counters[key] = counters.get(key, 0.0) + c["value"]
+            for g in snap.get("gauges", ()):
+                key = _key(g["name"], g["labels"])
+                if key not in gauges or gauges[key][0] <= snap_now:
+                    gauges[key] = (snap_now, g["value"])
+            for h in snap.get("histograms", ()):
+                key = _key(h["name"], h["labels"])
+                agg = hists.get(key)
+                if agg is None or agg["bounds"] != h["bounds"]:
+                    if agg is not None:
+                        logger.warning(
+                            "histogram %s: mismatched bounds across "
+                            "sources; keeping the newer series", h["name"],
+                        )
+                    hists[key] = {
+                        "bounds": list(h["bounds"]),
+                        "counts": list(h["counts"]),
+                        "sum": h["sum"],
+                        "count": h["count"],
+                    }
+                else:
+                    agg["counts"] = [
+                        a + b for a, b in zip(agg["counts"], h["counts"])
+                    ]
+                    agg["sum"] += h["sum"]
+                    agg["count"] += h["count"]
+        return {
+            "counters": [
+                {"name": n, "labels": dict(l), "value": v}
+                for (n, l), v in sorted(counters.items())
+            ],
+            "gauges": [
+                {"name": n, "labels": dict(l), "value": v}
+                for (n, l), (_, v) in sorted(gauges.items())
+            ],
+            "histograms": [
+                {"name": n, "labels": dict(l), **h}
+                for (n, l), h in sorted(hists.items())
+            ],
+        }
+
+    def report(self, now: float | None = None) -> dict:
+        """The operator-facing payload the servicer serves and
+        ``tools/obs_report.py`` renders. Built from ONE snapshot-set
+        copy, so a concurrent agent update cannot tear the report (a
+        timeline source missing from "sources"/"snapshots")."""
+        snaps = self.snapshots()
+        return {
+            "sources": sorted(s["source"] for s in snaps),
+            "ledger": goodput_ledger(snaps, now=now),
+            "timeline": self.merged_events(snaps),
+            "metrics": self.metrics_rollup(snaps),
+            "snapshots": {s["source"]: s for s in snaps},
+        }
+
+    @classmethod
+    def from_dir(cls, path: str) -> "JobTelemetry":
+        """Build from snapshot files (the flush side-channel; survives
+        every process of the job)."""
+        jt = cls()
+        for fpath, _source in snapshot_files(path):
+            try:
+                with open(fpath) as f:
+                    jt.update(json.load(f))
+            except (OSError, ValueError) as e:
+                logger.warning(
+                    "skipping unreadable snapshot %s: %s", fpath, e
+                )
+        return jt
+
+
+# -------------------------------------------------------------------------
+# rendering (shared by tools/obs_report.py and tools/chaos_run.py)
+# -------------------------------------------------------------------------
+
+
+def format_report(report: dict, timeline_tail: int = 40) -> str:
+    lines = []
+    ledger = report.get("ledger", {})
+    total = ledger.get("total_s", 0.0)
+    lines.append("=== goodput ledger ===")
+    lines.append(f"total wall-clock: {total:.3f}s  "
+                 f"(goodput {ledger.get('goodput', 0.0) * 100:.1f}%)")
+    for cat in CATEGORIES:
+        secs = ledger.get("categories", {}).get(cat, 0.0)
+        pct = (secs / total * 100) if total > 0 else 0.0
+        lines.append(f"{secs:10.3f}s  {pct:5.1f}%  {cat}")
+    timeline = report.get("timeline", [])
+    lines.append("")
+    lines.append(f"=== event timeline (last {min(timeline_tail, len(timeline))}"
+                 f" of {len(timeline)}) ===")
+    t0 = timeline[0]["t"] if timeline else 0.0
+    for ev in timeline[-timeline_tail:]:
+        extras = {
+            k: v for k, v in ev.items()
+            if k not in ("seq", "t", "mono", "kind", "source")
+        }
+        extra_s = " ".join(
+            f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in extras.items()
+        )
+        lines.append(
+            f"+{ev['t'] - t0:9.3f}s  {ev.get('source', '?'):<24} "
+            f"{ev['kind']:<20} {extra_s}"
+        )
+    metrics = report.get("metrics", {})
+    counters = metrics.get("counters", [])
+    if counters:
+        lines.append("")
+        lines.append("=== counters ===")
+        for c in counters:
+            label_s = ",".join(f"{k}={v}" for k, v in c["labels"].items())
+            lines.append(f"{c['value']:10.0f}  {c['name']}"
+                         + (f"{{{label_s}}}" if label_s else ""))
+    hists = metrics.get("histograms", [])
+    if hists:
+        lines.append("")
+        lines.append("=== histograms ===")
+        for h in hists:
+            label_s = ",".join(f"{k}={v}" for k, v in h["labels"].items())
+            avg = h["sum"] / h["count"] if h["count"] else 0.0
+            lines.append(
+                f"{h['count']:8d} obs  avg {avg * 1e3:9.3f} ms  {h['name']}"
+                + (f"{{{label_s}}}" if label_s else "")
+            )
+    profile = report.get("profile")
+    if profile:
+        lines.append("")
+        lines.append("=== profiled step breakdown (XPlane trace) ===")
+        lines.append(
+            f"total self time {profile.get('total_ms_per_step', 0.0):.1f} "
+            f"ms/step over {profile.get('steps', 1)} step(s)"
+        )
+        for cat, ms in sorted(
+            profile.get("by_category", {}).items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(f"{ms:8.2f} ms/step  {cat}")
+    return "\n".join(lines)
+
+
+install_from_env()
+# flush is a no-op unless DLROVER_TELEMETRY_DIR is set; with it set, a
+# cleanly exiting process (incl. SystemExit) leaves its final snapshot
+# behind without every caller remembering to flush
+atexit.register(flush)

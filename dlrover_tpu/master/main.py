@@ -40,6 +40,28 @@ def parse_args(argv=None):
 
 
 def run(args) -> int:
+    import signal
+
+    from dlrover_tpu.common import telemetry
+
+    if telemetry.active_registry() is not None:
+        # label this process's snapshots as the master (the registry
+        # was created at import, before we knew the role)
+        import os
+
+        os.environ.setdefault(telemetry.ENV_ROLE, "master")
+        telemetry.enable()
+    def _terminate(signum, frame):  # noqa: ARG001
+        raise SystemExit(143)
+
+    try:
+        # tpu-run stops this subprocess with SIGTERM; the default
+        # handler exits without finally/atexit, silently dropping the
+        # master's telemetry (rendezvous events) and the clean stop().
+        # Raising SystemExit runs both.
+        signal.signal(signal.SIGTERM, _terminate)
+    except ValueError:
+        pass  # not the main thread (embedded use)
     job_args = new_job_args(
         args.platform,
         args.job_name,

@@ -107,9 +107,20 @@ class LocalJobMaster(JobMaster):
         logger.info("LocalJobMaster serving on %s", self.addr)
 
     def run(self):
+        from dlrover_tpu.common import telemetry
+
         tasks_done_at = 0.0
+        last_flush = 0.0
         try:
             while True:
+                # periodic flush: tpu-run terminates this subprocess
+                # with SIGTERM (no atexit), and the master's rendezvous
+                # events must survive into the post-run obs report.
+                # Same cadence as the other reporters — a full-registry
+                # serialization every second would be pure waste.
+                if time.time() - last_flush >= JobConstant.MONITOR_INTERVAL:
+                    telemetry.flush()
+                    last_flush = time.time()
                 if self.servicer.job_ended:
                     logger.info("job ended, master exiting")
                     return 0 if self.servicer.job_success else 1
@@ -137,6 +148,9 @@ class LocalJobMaster(JobMaster):
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop()
+        from dlrover_tpu.common import telemetry
+
+        telemetry.flush()
 
 
 class DistributedJobMaster(JobMaster):
@@ -257,9 +271,12 @@ class DistributedJobMaster(JobMaster):
 
     def run(self) -> int:
         """Supervision loop (reference dist_master.py:211-269)."""
+        from dlrover_tpu.common import telemetry
+
         try:
             while True:
                 time.sleep(JobConstant.SECTION_LOOP_INTERVAL)
+                telemetry.flush()  # survive a SIGTERM-without-atexit
                 if self.servicer.job_ended:
                     self._exit_code = 0 if self.servicer.job_success else 1
                     self._exit_reason = JobExitReason.SUCCEEDED
@@ -335,3 +352,6 @@ class DistributedJobMaster(JobMaster):
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop()
+        from dlrover_tpu.common import telemetry
+
+        telemetry.flush()

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 import time
 
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import (
     JobConstant,
@@ -61,6 +62,12 @@ class RendezvousManager:
         self._first_join_time = 0.0
         self._coordinator_port = 0
         self._node_times: dict[int, float] = {}
+        # node_rank -> set of locally-restorable checkpoint steps the
+        # agent reported at join; consensus = newest step COMMON to all
+        # members of a formed round, broadcast so every host restores
+        # the SAME step (a step any host lacks is never forced)
+        self._verified_steps: dict[int, frozenset] = {}
+        self._restore_step = -1
 
     def update_rdzv_params(
         self, min_nodes, max_nodes, waiting_timeout, node_unit
@@ -86,6 +93,7 @@ class RendezvousManager:
         blocking in collectives with a dead peer."""
         with self._lock:
             removed = self._waiting_nodes.pop(node_rank, None) is not None
+            self._verified_steps.pop(node_rank, None)
             if node_rank in self._rdzv_nodes:
                 self._rdzv_nodes.pop(node_rank)
                 for rank, info in self._rdzv_nodes.items():
@@ -98,16 +106,33 @@ class RendezvousManager:
                     "%s: removed dead node %s", self.name, node_rank
                 )
 
+    @staticmethod
+    def _step_set(verified_ckpt_step: int, verified_ckpt_steps) -> frozenset:
+        """Normalize a join's availability report: the step list wins;
+        a scalar-only report (older client) is a singleton set."""
+        steps = {int(s) for s in (verified_ckpt_steps or ()) if int(s) >= 0}
+        if not steps and verified_ckpt_step >= 0:
+            steps = {int(verified_ckpt_step)}
+        return frozenset(steps)
+
     def join_rendezvous(
-        self, node_rank: int, local_world_size: int, node_ip: str = ""
+        self, node_rank: int, local_world_size: int, node_ip: str = "",
+        verified_ckpt_step: int = -1, verified_ckpt_steps=None,
     ) -> int:
         # master-side fault site: a dropped/delayed join is the server
         # half of a flaky control plane (the client half is rpc.send)
         chaos_point("rdzv.join", rank=node_rank, name=self.name)
+        telemetry.event(
+            "rdzv.join", rank=node_rank, name=self.name,
+            verified_step=verified_ckpt_step,
+        )
         with self._lock:
             if not self._waiting_nodes:
                 self._first_join_time = time.time()
             self._waiting_nodes[node_rank] = (local_world_size, node_ip)
+            self._verified_steps[node_rank] = self._step_set(
+                verified_ckpt_step, verified_ckpt_steps
+            )
             # joining invalidates the current formed round
             self._rdzv_nodes = {}
             return self._rdzv_round
@@ -146,11 +171,41 @@ class RendezvousManager:
         for r in ranks:
             self._waiting_nodes.pop(r, None)
         self._rdzv_round += 1
+        # restore-step consensus: the NEWEST step every member can
+        # actually load. Forcing min-of-newest instead would pick steps
+        # some hosts pruned or never persisted, and those hosts would
+        # silently restore something older — the exact split-world the
+        # consensus exists to prevent. No common step (or any member
+        # with nothing restorable) -> no forcing.
+        step_sets = [self._verified_steps.get(r) for r in ranks]
+        if step_sets and all(step_sets):
+            common = frozenset.intersection(*step_sets)
+            self._restore_step = max(common) if common else -1
+            if not common:
+                logger.warning(
+                    "%s: no checkpoint step is restorable on every "
+                    "member (%s); hosts restore their local newest",
+                    self.name,
+                    {r: sorted(s) for r, s in
+                     zip(ranks, step_sets)},
+                )
+        else:
+            self._restore_step = -1
+        telemetry.event(
+            "rdzv.complete",
+            name=self.name,
+            round=self._rdzv_round,
+            world=len(ranks),
+            restore_step=self._restore_step,
+            dur=max(time.time() - self._first_join_time, 0.0),
+        )
         logger.info(
-            "%s rendezvous round %d formed with nodes %s",
+            "%s rendezvous round %d formed with nodes %s "
+            "(consensus restore step %s)",
             self.name,
             self._rdzv_round,
             ranks,
+            self._restore_step,
         )
 
     def get_comm_world(self, node_rank: int):
@@ -158,6 +213,14 @@ class RendezvousManager:
 
     def rdzv_round(self) -> int:
         return self._rdzv_round
+
+    def consensus_restore_step(self) -> int:
+        """The NEWEST checkpoint step restorable on every member of the
+        latest formed round (-1 = no forcing). Hosts restore exactly
+        this step so a verified fallback can never split the world
+        across steps."""
+        with self._lock:
+            return self._restore_step
 
     def clear_waiting_nodes(self):
         with self._lock:
@@ -344,15 +407,23 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             self._node_times_by_round.setdefault(rnd, {})[node_rank] = elapsed
 
     def join_rendezvous(
-        self, node_rank: int, local_world_size: int, node_ip: str = ""
+        self, node_rank: int, local_world_size: int, node_ip: str = "",
+        verified_ckpt_step: int = -1, verified_ckpt_steps=None,
     ) -> int:
         chaos_point("rdzv.join", rank=node_rank, name=self.name)
+        telemetry.event(
+            "rdzv.join", rank=node_rank, name=self.name,
+            verified_step=verified_ckpt_step,
+        )
         with self._lock:
             if not self._waiting_nodes:
                 self._first_join_time = time.time()
                 self._fault_nodes.clear()
                 self._stragglers.clear()
             self._waiting_nodes[node_rank] = (local_world_size, node_ip)
+            self._verified_steps[node_rank] = self._step_set(
+                verified_ckpt_step, verified_ckpt_steps
+            )
             self._rdzv_nodes = {}
             return self._rdzv_round
 

@@ -15,6 +15,7 @@ from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import RpcServer, RpcService
+from dlrover_tpu.common.telemetry import JobTelemetry
 
 logger = get_logger(__name__)
 
@@ -110,6 +111,9 @@ class MasterServicer(RpcService):
         self.job_metric_collector = job_metric_collector
         self.elastic_ps_service = elastic_ps_service
         self.ckpt_barrier = CheckpointBarrierService()
+        # job-wide telemetry merge: agents ship registry snapshots, the
+        # report query serves the goodput ledger + merged timeline
+        self.telemetry = JobTelemetry()
         self._start_training_time = 0.0
         self._job_ended = threading.Event()
         self._job_success = True
@@ -175,6 +179,15 @@ class MasterServicer(RpcService):
                 message.group, message.step, message.world
             )
             return msg.BarrierResponse(passed=passed, aborted=aborted)
+        if isinstance(message, msg.TelemetryReportRequest):
+            # fold in THIS process's registry (rendezvous events live
+            # here): the master is a telemetry source like any other
+            from dlrover_tpu.common import telemetry as _telemetry
+
+            local_snap = _telemetry.snapshot()
+            if local_snap is not None:
+                self.telemetry.update(local_snap)
+            return msg.TelemetryReport(payload=self.telemetry.report())
         if isinstance(message, msg.ElasticRunConfigRequest):
             return msg.ElasticRunConfig(configs=dict(self._run_configs))
         if isinstance(message, msg.SyncBarrierRequest):
@@ -240,7 +253,16 @@ class MasterServicer(RpcService):
             if mgr is None:
                 return False
             mgr.join_rendezvous(
-                message.node_rank, message.local_world_size, message.node_ip
+                message.node_rank,
+                message.local_world_size,
+                message.node_ip,
+                # older clients' pickles predate these fields
+                verified_ckpt_step=getattr(
+                    message, "verified_ckpt_step", -1
+                ),
+                verified_ckpt_steps=getattr(
+                    message, "verified_ckpt_steps", None
+                ),
             )
             return True
         if isinstance(message, msg.NodeCheckResultRequest):
@@ -308,6 +330,8 @@ class MasterServicer(RpcService):
             self._job_success = message.success
             self._job_ended.set()
             return True
+        if isinstance(message, msg.TelemetrySnapshot):
+            return self.telemetry.update(message.payload)
         if isinstance(message, msg.DiagnosisReport):
             logger.info(
                 "diagnosis from %s-%s [%s]: %s",
@@ -364,6 +388,9 @@ class MasterServicer(RpcService):
             group=group,
             world=world,
             coordinator_addr=coordinator,
+            restore_step=(
+                mgr.consensus_restore_step() if world else -1
+            ),
         )
 
     def _get_paral_config(self, node_type, node_id):

@@ -40,14 +40,16 @@ from dlrover_tpu.agent.ckpt_saver import (
     SAVER_FACTORY_QUEUE,
     SaveEvent,
     SharedMemoryHandler,
+    _VERIFIED_MARKER,
     event_queue_name,
     host_shard_filename,
     lock_name,
     read_host_shard,
     verify_step_dir,
 )
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.chaos import chaos_point
-from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.constants import CheckpointConstant, NodeEnv
 from dlrover_tpu.common.ipc import SharedLock, SharedQueue
 from dlrover_tpu.common.log import get_logger
 
@@ -406,6 +408,14 @@ class CheckpointEngine:
             elapsed,
             offset / 1e6,
         )
+        # goodput: the trainer blocks for exactly this window (the
+        # async persist downstream does not count). Emitted BEFORE the
+        # chaos site so a kill-after-save leaves the save on the
+        # timeline ahead of the fire.
+        telemetry.event(
+            "ckpt.save", step=step, dur=elapsed, mb=offset / 1e6
+        )
+        telemetry.observe("ckpt.save.seconds", elapsed)
         # fault site AFTER the shm save committed: a kill here is the
         # canonical "worker dies right after checkpointing step N" —
         # the agent-held shm segment must carry the restore
@@ -532,11 +542,109 @@ class CheckpointEngine:
         fresh array (also marked read-only for a uniform contract).
         The *targeted* restore path ignores ``zero_copy`` — it is
         already shard-wise (peak host memory ~one shard) and
-        device-transfer-bound."""
-        result = self._load_from_memory(target, zero_copy=zero_copy)
+        device-transfer-bound.
+
+        When the master brokered a restore-step consensus (the agent
+        exports ``DLROVER_TPU_RESTORE_STEP`` from rendezvous), shm is
+        used only if it holds exactly that step, and storage candidates
+        are capped at it — every host of the round restores the SAME
+        step even when some hold newer local state."""
+        t0 = time.monotonic()
+        consensus = self._consensus_restore_step()
+        use_shm = True
+        if consensus is not None:
+            shm_step = self._shm_handler.get_checkpoint_step()
+            use_shm = shm_step == consensus
+            if shm_step > consensus:
+                telemetry.event(
+                    "ckpt.consensus.forced",
+                    step=consensus,
+                    local_newest=shm_step,
+                    source_kind="shm",
+                )
+                logger.warning(
+                    "consensus restore step %d overrides newer local "
+                    "shm checkpoint (step %d)", consensus, shm_step,
+                )
+        if use_shm:
+            result = self._load_from_memory(target, zero_copy=zero_copy)
+            if result is not None:
+                self._record_restore(result, "shm", t0, consensus)
+                return result
+        result = self.load_from_storage(
+            path, target, max_step=consensus
+        )
+        if consensus is not None and not path:
+            # the consensus step was advertised as restorable on every
+            # host, this one included (the agent's join said so); a
+            # quiet restore of anything OLDER would resume this host at
+            # a different step than its peers — the exact split-world
+            # the consensus exists to prevent. Fail loudly instead: the
+            # agent restarts the worker and the next rendezvous
+            # recomputes availability from what is actually on disk.
+            got = self._result_step(result)
+            if got != consensus:
+                # loop-breaker: the advertisement scan trusts the
+                # .verified CRC cache, and post-verify bit-rot (size
+                # unchanged) can keep a rotten dir advertised forever;
+                # dropping its marker forces the next join's scan to
+                # re-CRC the dir and stop advertising it, so the
+                # restart converges instead of livelocking
+                marker = os.path.join(
+                    self.checkpoint_dir,
+                    f"{CheckpointConstant.STEP_DIR_PREFIX}{consensus}",
+                    _VERIFIED_MARKER,
+                )
+                try:
+                    os.remove(marker)
+                except OSError:
+                    pass
+                raise ValueError(
+                    f"consensus restore step {consensus} is not "
+                    f"restorable on this host (newest loadable: "
+                    f"{got if got >= 0 else 'none'}) — refusing to "
+                    f"silently resume at a different step than the "
+                    f"rest of the job"
+                )
         if result is not None:
-            return result
-        return self.load_from_storage(path, target)
+            self._record_restore(result, "storage", t0, consensus)
+        return result
+
+    @staticmethod
+    def _result_step(result) -> int:
+        if result is None:
+            return -1
+        if isinstance(result, tuple):
+            return int(result[1])
+        return int(result.get("step", -1))
+
+    @staticmethod
+    def _consensus_restore_step() -> int | None:
+        """Master-brokered min verified step (env, set by the agent per
+        rendezvous round); None = unconstrained local restore."""
+        raw = os.environ.get(NodeEnv.RESTORE_STEP, "")
+        if not raw:
+            return None
+        try:
+            step = int(raw)
+        except ValueError:
+            logger.warning(
+                "ignoring malformed %s=%r", NodeEnv.RESTORE_STEP, raw
+            )
+            return None
+        return step if step >= 0 else None
+
+    @classmethod
+    def _record_restore(cls, result, source_kind: str, t0: float, consensus):
+        fields = dict(
+            step=cls._result_step(result),
+            source_kind=source_kind,
+            dur=time.monotonic() - t0,
+        )
+        if consensus is not None:
+            fields["consensus"] = consensus
+        telemetry.event("ckpt.restore", **fields)
+        telemetry.observe("ckpt.restore.seconds", fields["dur"])
 
     def _load_from_memory(self, target=None, zero_copy: bool = False):
         result = self._shm_handler.read()
@@ -617,7 +725,9 @@ class CheckpointEngine:
         logger.info("restored step %s from shared memory", meta.step)
         return _fill_target(state, target, meta.step)
 
-    def load_from_storage(self, path: str = "", target=None):
+    def load_from_storage(
+        self, path: str = "", target=None, max_step: int | None = None,
+    ):
         """Restore from storage with VERIFIED fallback.
 
         Candidate step dirs are tried newest-first; each must pass
@@ -640,6 +750,33 @@ class CheckpointEngine:
         candidates get the deep payload-crc verify.
         """
         candidates = [path] if path else self._candidate_step_dirs()
+        if not path and max_step is not None:
+            # consensus cap: steps newer than the job-wide agreed
+            # restore step are off-limits (an explicit path stays the
+            # caller's responsibility — they asked for that exact state)
+            kept, skipped_steps = [], []
+            prefix = CheckpointConstant.STEP_DIR_PREFIX
+            for step_dir in candidates:
+                try:
+                    step = int(os.path.basename(step_dir)[len(prefix):])
+                except ValueError:
+                    step = -1
+                if step > max_step:
+                    skipped_steps.append(step)
+                else:
+                    kept.append(step_dir)
+            if skipped_steps:
+                telemetry.event(
+                    "ckpt.consensus.forced",
+                    step=max_step,
+                    local_newest=max(skipped_steps),
+                    source_kind="storage",
+                )
+                logger.warning(
+                    "consensus restore step %d skips newer local "
+                    "storage steps %s", max_step, sorted(skipped_steps),
+                )
+            candidates = kept
         for step_dir in candidates:
             if not step_dir or not os.path.isdir(step_dir):
                 if path:
@@ -658,6 +795,12 @@ class CheckpointEngine:
                         f"verification ({reason}) — refusing to load "
                         f"an explicitly named torn/corrupt checkpoint"
                     )
+                telemetry.event(
+                    "ckpt.fallback",
+                    dir=os.path.basename(step_dir),
+                    reason=reason[:200],
+                )
+                telemetry.counter_inc("ckpt.fallbacks")
                 logger.warning(
                     "checkpoint %s failed integrity verification (%s); "
                     "falling back to an older checkpoint",
@@ -677,6 +820,12 @@ class CheckpointEngine:
                     f"its payload checks — refusing to substitute "
                     f"anything for an explicitly named checkpoint"
                 )
+            telemetry.event(
+                "ckpt.fallback",
+                dir=os.path.basename(step_dir),
+                reason="incomplete",
+            )
+            telemetry.counter_inc("ckpt.fallbacks")
             logger.warning(
                 "checkpoint %s is incomplete; falling back to an older "
                 "checkpoint", step_dir,
@@ -687,23 +836,15 @@ class CheckpointEngine:
         """All persisted step dirs, newest first. The tracker's step is
         just the first candidate — a tracker advertising a step whose
         dir fails verification must not brick the restore."""
+        from dlrover_tpu.agent.ckpt_saver import list_step_numbers
+
         prefix = CheckpointConstant.STEP_DIR_PREFIX
-        steps: set[int] = set()
+        steps = set(list_step_numbers(self.checkpoint_dir))
         tracker_step = AsyncCheckpointSaver.get_latest_step(
             self.checkpoint_dir
         )
         if tracker_step >= 0:
             steps.add(tracker_step)
-        try:
-            for name in os.listdir(self.checkpoint_dir):
-                if not name.startswith(prefix) or name.endswith(".tmp"):
-                    continue
-                try:
-                    steps.add(int(name[len(prefix):]))
-                except ValueError:
-                    continue
-        except OSError:
-            pass
         return [
             os.path.join(self.checkpoint_dir, f"{prefix}{s}")
             for s in sorted(steps, reverse=True)

@@ -18,6 +18,7 @@ import os
 import time
 from typing import Any, Callable, Iterable, Optional
 
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.accelerate import auto_accelerate
 from dlrover_tpu.parallel.strategy import Strategy
@@ -139,6 +140,9 @@ class Trainer:
         )
         self.state = self._accel.state
         self.global_step = 0
+        # first train_step of this process incarnation traces+compiles;
+        # its wall time is attributed to the "compile" goodput category
+        self._compiled_once = False
         # step the on-disk pending/latest prestep sidecar was last
         # serialized at (skip-rewrite cache; None = dirty)
         self._prestep_sidecar_step = None
@@ -321,10 +325,29 @@ class Trainer:
                     self._profiler.maybe_stop(
                         self.global_step - 1, block_on=metrics
                     )
+                dur_ns = time.time_ns() - t0
                 if self._timer is not None:
-                    self._timer.record(
-                        Tag.STEP, t0, time.time_ns() - t0
+                    self._timer.record(Tag.STEP, t0, dur_ns)
+                dur_s = dur_ns / 1e9
+                if self._compiled_once:
+                    telemetry.event(
+                        "step.end", step=self.global_step, dur=dur_s
                     )
+                else:
+                    telemetry.event(
+                        "compile", step=self.global_step, dur=dur_s
+                    )
+                    self._compiled_once = True
+                telemetry.observe("train.step.seconds", dur_s)
+                if dur_s > 0:
+                    telemetry.gauge_set(
+                        "train.steps_per_s", 1.0 / dur_s
+                    )
+                    tokens = self._batch_tokens(batch)
+                    if tokens:
+                        telemetry.gauge_set(
+                            "train.tokens_per_s", tokens / dur_s
+                        )
                 if args.log_steps and \
                         self.global_step % args.log_steps == 0:
                     loss = float(metrics.get("loss", float("nan")))
@@ -332,6 +355,7 @@ class Trainer:
                         "step %d epoch %d loss %.5f",
                         self.global_step, epoch, loss,
                     )
+                    telemetry.flush()
                 write_runtime_metrics(self.global_step)
                 if (
                     self._engine is not None
@@ -365,10 +389,42 @@ class Trainer:
                     break
                 time.sleep(0.2)
             else:
+                t_wait = time.monotonic()
                 self._engine.wait_for_persist(
                     self.global_step, timeout=300
                 )
+                # the ONLY persist the training loop blocks on — unlike
+                # cadence persists it is real lost wall-clock
+                telemetry.event(
+                    "ckpt.persist.wait",
+                    step=self.global_step,
+                    dur=time.monotonic() - t_wait,
+                )
+        telemetry.flush()
         return self.state, metrics
+
+    @staticmethod
+    def _batch_tokens(batch) -> int:
+        """Best-effort token count for the throughput gauge: the first
+        2-D integer leaf (token ids) wins; 0 when the batch has none
+        (e.g. dense regression batches)."""
+        try:
+            import jax
+            import numpy as np
+
+            for leaf in jax.tree_util.tree_leaves(batch):
+                shape = getattr(leaf, "shape", None)
+                dtype = getattr(leaf, "dtype", None)
+                if (
+                    shape is not None
+                    and len(shape) == 2
+                    and dtype is not None
+                    and np.issubdtype(np.dtype(dtype), np.integer)
+                ):
+                    return int(shape[0]) * int(shape[1])
+        except Exception:  # noqa: BLE001 - throughput gauge is garnish
+            pass
+        return 0
 
     # --------------------------------------------------------- checkpoints
 

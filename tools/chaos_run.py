@@ -27,8 +27,9 @@ sys.path.insert(
 )
 
 WORKER = """
-import json, os
+import json, os, time
 import jax.numpy as jnp
+from dlrover_tpu.common import telemetry
 from dlrover_tpu.trainer.flash_checkpoint.engine import (
     ReplicatedCheckpointEngine,
 )
@@ -44,7 +45,9 @@ else:
     w = jnp.asarray(list(restored["state"].values())[0])
 
 for step in range(start + 1, total + 1):
+    t0 = time.time()
     w = w + 1.0
+    telemetry.event("step.end", step=step, dur=time.time() - t0)
     if step % 2 == 0:
         # synchronous persist: an in-flight persist would hold the shm
         # lock and make later saves skip (never reaching their fault
@@ -53,6 +56,7 @@ for step in range(start + 1, total + 1):
         engine.wait_for_persist(step, timeout=60)
     else:
         engine.save_to_memory(step, {"w": w})
+    telemetry.flush()
 
 with open(out_dir + "/result.json", "w") as f:
     json.dump({
@@ -84,8 +88,11 @@ def main() -> int:
     args = parser.parse_args()
 
     # env must be armed BEFORE dlrover_tpu imports anywhere (the chaos
-    # module reads it once at import), and before jax picks a backend
+    # and telemetry modules read it once at import), and before jax
+    # picks a backend. This process hosts the agent AND the in-process
+    # local master; its telemetry source is labeled "agent".
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DLROVER_TELEMETRY_ROLE", "agent")
     from dlrover_tpu.common import chaos
 
     if args.list or not args.schedule:
@@ -101,6 +108,10 @@ def main() -> int:
     os.environ["CHAOS_TOTAL_STEPS"] = str(args.steps)
     os.environ["DLROVER_TPU_SOCKET_DIR"] = os.path.join(out_dir, "socks")
     os.environ["ELASTIC_JOB_NAME"] = f"chaos_run_{os.getpid()}"
+    # telemetry: every process (this one + workers) leaves a snapshot so
+    # the post-run goodput ledger/timeline can be assembled
+    tele_dir = os.path.join(out_dir, "telemetry")
+    os.environ.setdefault("DLROVER_TELEMETRY_DIR", tele_dir)
     # the worker subprocess arms itself from this env; this (agent)
     # process stays clean so master/agent control flow is unperturbed
     # unless the schedule targets agent/master sites — then arm locally
@@ -149,6 +160,21 @@ def main() -> int:
     reg = chaos.active_registry()
     if reg is not None:
         print(f"agent-side chaos fires: {reg.summary()}")
+    from dlrover_tpu.common import telemetry
+    from dlrover_tpu.common.telemetry import JobTelemetry, format_report
+
+    telemetry.flush()  # this (agent/master) process's snapshot
+    report = JobTelemetry.from_dir(
+        os.environ["DLROVER_TELEMETRY_DIR"]
+    ).report()
+    if report["sources"]:
+        print()
+        print(format_report(report, timeline_tail=30))
+        if args.keep or args.out_dir:
+            print(
+                "\nfull report: python tools/obs_report.py --dir "
+                + os.environ["DLROVER_TELEMETRY_DIR"]
+            )
     print(f"work dir: {out_dir}" + ("" if args.keep else " (removing)"))
     if not args.keep and not args.out_dir:
         shutil.rmtree(out_dir, ignore_errors=True)

@@ -1,0 +1,119 @@
+"""Operator-facing observability report: goodput ledger, merged event
+timeline, and metrics — from telemetry snapshot files and/or a live
+master.
+
+Usage:
+    # from a snapshot directory (DLROVER_TELEMETRY_DIR of the run)
+    python tools/obs_report.py --dir /path/to/telemetry
+
+    # from a live master (the servicer's telemetry query)
+    python tools/obs_report.py --master 127.0.0.1:12345
+
+    # embed the XPlane per-category breakdown when a trace exists
+    python tools/obs_report.py --dir ... --trace-dir out/profile --steps 3
+
+    # machine-readable (the bench embeds this)
+    python tools/obs_report.py --dir ... --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def build_report(
+    telemetry_dir: str | None = None,
+    master_addr: str | None = None,
+    trace_dir: str | None = None,
+    steps: int = 1,
+    now: float | None = None,
+) -> dict:
+    """Merge snapshots from a directory and/or a live master into one
+    report dict: {sources, ledger, timeline, metrics[, profile]}."""
+    from dlrover_tpu.common.telemetry import JobTelemetry
+
+    jt = JobTelemetry() if telemetry_dir is None else JobTelemetry.from_dir(
+        telemetry_dir
+    )
+    if master_addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(master_addr, 0, "tool")
+        try:
+            remote = client.get_telemetry_report()
+        finally:
+            client.close()
+        for snap in (remote.get("snapshots") or {}).values():
+            jt.update(snap)
+    report = jt.report(now=now)
+    # raw snapshots are an input detail, not operator output
+    report.pop("snapshots", None)
+    if trace_dir:
+        try:
+            from tools.parse_profile import summarize
+
+            report["profile"] = summarize(trace_dir, steps=steps)
+        except ImportError as e:
+            report["profile_error"] = f"xprof toolchain unavailable: {e}"
+        except Exception as e:  # noqa: BLE001 - a broken trace must not
+            # take the goodput report down with it
+            report["profile_error"] = f"trace parse failed: {e}"
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--dir", dest="telemetry_dir",
+        help="telemetry snapshot directory (DLROVER_TELEMETRY_DIR)",
+    )
+    parser.add_argument(
+        "--master", dest="master_addr",
+        help="live master address host:port (telemetry servicer query)",
+    )
+    parser.add_argument(
+        "--trace-dir", help="XPlane trace dir to embed a profile summary"
+    )
+    parser.add_argument(
+        "--steps", type=int, default=1,
+        help="profiled step count for --trace-dir normalization",
+    )
+    parser.add_argument(
+        "--timeline", type=int, default=40,
+        help="how many trailing timeline events to print",
+    )
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    if not args.telemetry_dir and not args.master_addr:
+        parser.error("need --dir and/or --master")
+
+    report = build_report(
+        telemetry_dir=args.telemetry_dir,
+        master_addr=args.master_addr,
+        trace_dir=args.trace_dir,
+        steps=args.steps,
+    )
+    if not report.get("sources"):
+        print("no telemetry snapshots found", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        from dlrover_tpu.common.telemetry import format_report
+
+        print(format_report(report, timeline_tail=args.timeline))
+        if report.get("profile_error"):
+            print(f"\n[profile skipped: {report['profile_error']}]",
+                  file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

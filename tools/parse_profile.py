@@ -1,37 +1,128 @@
-"""Parse an existing xplane trace into per-op/category self-times."""
+"""Parse an XPlane trace into per-op/category self-times.
+
+Usage:
+    python tools/parse_profile.py /path/to/trace_dir --steps 3
+    python tools/parse_profile.py /path/to/trace_dir --steps 3 --json
+
+The summary is importable (``summarize``) so ``tools/obs_report.py`` can
+embed the per-category step breakdown next to the goodput ledger when a
+trace exists.
+"""
+
+from __future__ import annotations
+
+import argparse
 import glob
 import json
+import os
 import sys
 
-from xprof.convert import raw_to_tool_data as rtd
 
-paths = glob.glob("/root/repo/_profile_out/**/*.xplane.pb", recursive=True)
-data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
-if isinstance(data, bytes):
-    data = data.decode()
-obj = json.loads(data)
-cols = [c["label"] for c in obj["cols"]]
-rows = [[c["v"] for c in r["c"]] for r in obj["rows"]]
-icat = cols.index("HLO op category")
-iname = cols.index("HLO op name")
-itime = cols.index("Total self time (us)")
-iocc = cols.index("#Occurrences")
+def summarize(trace_dir: str, steps: int = 1, top: int = 45) -> dict | None:
+    """Per-category/per-op self-time summary of every ``*.xplane.pb``
+    under ``trace_dir``. Returns None when no trace files exist.
+    Raises ImportError when the xprof toolchain is unavailable —
+    callers that merely *embed* the summary should catch it."""
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not paths:
+        return None
+    from xprof.convert import raw_to_tool_data as rtd
 
-steps = 3
-bycat = {}
-byop = {}
-for r in rows:
-    t = float(r[itime] or 0)
-    bycat[r[icat]] = bycat.get(r[icat], 0.0) + t
-    byop.setdefault((r[icat], r[iname]), [0.0, 0])
-    byop[(r[icat], r[iname])][0] += t
-    byop[(r[icat], r[iname])][1] += int(r[iocc] or 0)
+    data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    obj = json.loads(data)
+    cols = [c["label"] for c in obj["cols"]]
+    rows = [[c["v"] for c in r["c"]] for r in obj["rows"]]
+    icat = cols.index("HLO op category")
+    iname = cols.index("HLO op name")
+    itime = cols.index("Total self time (us)")
+    iocc = cols.index("#Occurrences")
 
-tot = sum(bycat.values())
-print(f"total self time {tot/steps/1e3:.1f} ms/step")
-print("\n=== by category ===")
-for cat, t in sorted(bycat.items(), key=lambda kv: -kv[1]):
-    print(f"{t/steps/1e3:8.2f} ms/step  {cat}")
-print("\n=== top 45 ops ===")
-for (cat, name), (t, occ) in sorted(byop.items(), key=lambda kv: -kv[1][0])[:45]:
-    print(f"{t/steps/1e3:8.3f} ms/step  x{occ:4d} {cat:22s} {name[:80]}")
+    steps = max(int(steps), 1)
+    bycat: dict[str, float] = {}
+    byop: dict[tuple, list] = {}
+    for r in rows:
+        t = float(r[itime] or 0)
+        bycat[r[icat]] = bycat.get(r[icat], 0.0) + t
+        byop.setdefault((r[icat], r[iname]), [0.0, 0])
+        byop[(r[icat], r[iname])][0] += t
+        byop[(r[icat], r[iname])][1] += int(r[iocc] or 0)
+
+    tot = sum(bycat.values())
+    return {
+        "trace_dir": trace_dir,
+        "steps": steps,
+        "num_traces": len(paths),
+        "total_ms_per_step": tot / steps / 1e3,
+        "by_category": {
+            cat: t / steps / 1e3 for cat, t in bycat.items()
+        },
+        "top_ops": [
+            {
+                "category": cat,
+                "op": name,
+                "ms_per_step": t / steps / 1e3,
+                "occurrences": occ,
+            }
+            for (cat, name), (t, occ) in sorted(
+                byop.items(), key=lambda kv: -kv[1][0]
+            )[:top]
+        ],
+    }
+
+
+def render(summary: dict) -> str:
+    lines = [
+        f"total self time {summary['total_ms_per_step']:.1f} ms/step "
+        f"({summary['num_traces']} trace file(s), "
+        f"{summary['steps']} step(s))",
+        "",
+        "=== by category ===",
+    ]
+    for cat, ms in sorted(
+        summary["by_category"].items(), key=lambda kv: -kv[1]
+    ):
+        lines.append(f"{ms:8.2f} ms/step  {cat}")
+    lines.append("")
+    lines.append(f"=== top {len(summary['top_ops'])} ops ===")
+    for op in summary["top_ops"]:
+        lines.append(
+            f"{op['ms_per_step']:8.3f} ms/step  x{op['occurrences']:4d} "
+            f"{op['category']:22s} {op['op'][:80]}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "trace_dir", help="directory searched recursively for *.xplane.pb"
+    )
+    parser.add_argument(
+        "--steps", type=int, default=1,
+        help="number of profiled steps the trace covers (per-step "
+        "normalization; default 1)",
+    )
+    parser.add_argument("--top", type=int, default=45)
+    parser.add_argument(
+        "--json", action="store_true", help="emit the summary as JSON"
+    )
+    args = parser.parse_args(argv)
+    try:
+        summary = summarize(args.trace_dir, steps=args.steps, top=args.top)
+    except ImportError as e:
+        print(f"xprof toolchain unavailable: {e}", file=sys.stderr)
+        return 2
+    if summary is None:
+        print(f"no *.xplane.pb traces under {args.trace_dir}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(summary, indent=2) if args.json else render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
