@@ -418,6 +418,18 @@ def main():
         state_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree.leaves(host_state)
         )
+        # METRIC FIX (BENCH_r05 anomaly): ckpt_shm_fill_gbps used to be
+        # state_bytes / transfer_s, but transfer_s is the whole drain
+        # window — dominated by the copier thread BLOCKING on each
+        # shard's in-flight D2H transfer (this environment's ~0.01 GB/s
+        # tunnel), so the "shm fill" metric was really re-measuring the
+        # device link (hence 0.007 GB/s against a multi-GB/s memcpy).
+        # The engine now times its two drain legs separately; the fill
+        # metric is the actual shm memcpy leg, and the D2H wait is
+        # disclosed alongside as ckpt_shm_d2h_wait_s.
+        drain_stats = dict(engine.last_save_stats)
+        fill_s = drain_stats.get("fill_s", 0.0)
+        shm_d2h_wait_s = drain_stats.get("materialize_s", 0.0)
         assert engine.latest_step() == 1
 
         # restore half of the north star (<10 s from the host-memory
@@ -425,10 +437,11 @@ def main():
         # restore_shm_s times the HOST-side state materialization under
         # the zero-copy contract (read-only shm-backed arrays, valid
         # until the next save); restore_shm_copy_s is the defensive
-        # full-copy variant. The targeted production restore
-        # (trainer.py engine.load(target=...)) is shard-wise and
-        # device-transfer-bound — its device leg is what restore_h2d_s
-        # measures below.
+        # full-copy variant — now ONE threaded native gather pass out
+        # of shm instead of a single-threaded numpy memcpy per leaf.
+        # The targeted production restore (trainer.py
+        # engine.load(target=...)) is shard-wise and device-transfer-
+        # bound — its device leg is what restore_h2d_s measures below.
         t0 = time.perf_counter()
         loaded = engine.load(zero_copy=True)
         restore_shm_s = time.perf_counter() - t0
@@ -451,15 +464,31 @@ def main():
         engine.save_to_storage(2, restored)
         persisted = engine.wait_for_persist(2, timeout=300)
         restore_disk_s = -1.0
+        restore_disk_read_s = restore_disk_verify_s = -1.0
         if persisted:
             t0 = time.perf_counter()
             from_disk = engine.load_from_storage()
             restore_disk_s = time.perf_counter() - t0
             assert from_disk is not None and from_disk, "disk restore empty"
+            # staged breakdown of the eager disk restore: parallel
+            # chunked shard reads with the CRC folded into the same
+            # pass (read_s/verify_s are summed thread-seconds; wall
+            # time is restore_disk_s)
+            dstats = dict(engine.last_restore_stats)
+            restore_disk_read_s = dstats.get("read_s", -1.0)
+            restore_disk_verify_s = dstats.get("verify_s", -1.0)
+
+        # H2D leg, PIPELINED: per-leaf transfers all dispatched before
+        # any is waited on, so through a multiplexing link the puts
+        # overlap instead of paying serial per-leaf round trips (the
+        # old whole-tree device_put + block measured the same bytes
+        # with zero overlap)
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            pipelined_device_put,
+        )
 
         t0 = time.perf_counter()
-        on_device = jax.device_put(restored)
-        jax.block_until_ready(on_device)
+        on_device = pipelined_device_put(restored)
         _ = float(jax.tree.leaves(on_device)[0].ravel()[0])
         restore_h2d_s = time.perf_counter() - t0
         del on_device
@@ -473,6 +502,9 @@ def main():
         # single-core tmpfs page fault-in for the fresh segment; the
         # production cadence (save every 30 s into the same segment)
         # runs at the WARM number, which is the steady-state claim.
+        # (The fresh segment is now PREFAULTED across threads at
+        # creation — dlrtpu_prefault — so the cold number should sit
+        # within ~2x of warm instead of the old 4-5x gap.)
         if on_tpu:
             synth_bytes = int(3.8 * (1 << 30))
         else:
@@ -554,7 +586,11 @@ def main():
 
     ckpt_interval = 30.0  # reference production cadence (flash_checkpoint.md)
     goodput = ckpt_interval / (ckpt_interval + ckpt_pause)
-    shm_gbps = state_bytes / transfer_s / (1 << 30)
+    # the fill leg only (see the METRIC FIX note above); the old
+    # whole-window division is kept as ckpt_background_transfer_s
+    shm_gbps = (
+        state_bytes / fill_s / (1 << 30) if fill_s > 0 else -1.0
+    )
 
     # schedule/precision overhead arms (nano-350m, relative to its own
     # bf16 step): 1F1B microbatched loss and the (emulated) fp8 path
@@ -579,6 +615,10 @@ def main():
         sparse = _sparse_bench(on_tpu)
     except Exception as e:  # noqa: BLE001 - best-effort micro-bench
         sparse = {"sparse_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+    from dlrover_tpu.common.arena import get_arena
+
+    arena_stats = get_arena().stats()
 
     print(json.dumps({
         "metric": "training_goodput_with_flash_ckpt",
@@ -607,7 +647,12 @@ def main():
             "ckpt_state_gb": round(state_bytes / (1 << 30), 3),
             "ckpt_background_transfer_s": round(transfer_s, 2),
             "ckpt_overlapped_train_steps": overlapped,
+            # the shm MEMCPY leg of the drain only (metric fixed: the
+            # old value divided state bytes by the whole drain window
+            # and so reported the device link); the D2H wait the copier
+            # thread spends blocked on the link is disclosed separately
             "ckpt_shm_fill_gbps": round(shm_gbps, 3),
+            "ckpt_shm_d2h_wait_s": round(shm_d2h_wait_s, 3),
             "ckpt_shm_scatter_gbps": round(shm_scatter_gbps, 2),
             # full engine path over a host-resident headline-sized
             # state: engine-limited, vs device_link_* = link ceiling.
@@ -633,7 +678,20 @@ def main():
                 round(t, 3) for t in restore_shm_headline_copy_s_minmax
             ],
             "restore_disk_s": round(restore_disk_s, 3),
+            # staged restore breakdown (tentpole: the return trip is a
+            # pipeline now) — disk reads are chunk-parallel with the
+            # CRC folded into the read pass (read/verify are summed
+            # thread-seconds), and the H2D leg dispatches every leaf
+            # before waiting on any
+            "restore_disk_read_s": round(restore_disk_read_s, 3),
+            "restore_disk_verify_s": round(restore_disk_verify_s, 3),
             "restore_h2d_s": round(restore_h2d_s, 3),
+            "restore_h2d_mode": "pipelined-per-leaf",
+            # host-arena reuse for the deep-verify CRC staging buffers
+            # (the COLD-save fix is the threaded shm prefault, not the
+            # arena — see ckpt_engine_cold_gbps above)
+            "ckpt_arena_hits": arena_stats["hits"],
+            "ckpt_arena_misses": arena_stats["misses"],
             "ckpt_saver_path": saver_path,
             # measured device link (remote tunnel in this environment):
             # restore_h2d_s / ckpt_background_transfer_s scale with these

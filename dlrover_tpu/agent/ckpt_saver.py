@@ -71,6 +71,16 @@ def event_queue_name(local_rank: int = 0) -> str:
     return f"ckpt_event_{local_rank}"
 
 
+def persist_done_queue_name(local_rank: int = 0) -> str:
+    """Agent -> worker persist-completion wakeups: the saver puts the
+    persisted step here after the commit protocol, so the engine's
+    ``wait_for_persist`` (and the trainer's final-save retry loop) wake
+    on the event instead of quantizing end-of-run latency to a poll
+    interval. The tracker file stays the source of truth — the queue is
+    only a wakeup hint, bounded and droppable."""
+    return f"ckpt_done_{local_rank}"
+
+
 @dataclass
 class LeafMeta:
     """One array (or array shard) in the shm buffer."""
@@ -129,6 +139,23 @@ class SharedMemoryHandler:
             self._shm = get_or_create_shm(
                 shm_name(self._local_rank), size
             )
+            if getattr(self._shm, "just_created", False):
+                # A FRESH segment's pages fault in on first touch; left
+                # to the copy loop that tax is paid inside the timed
+                # save interleaved with the memcpy (the
+                # ckpt_engine_cold_gbps vs warm gap). Fault them in NOW
+                # with a dedicated page-touch pass — measurably ~4-6x
+                # cheaper than faulting from inside a large memcpy even
+                # single-threaded, and threaded on multi-core hosts.
+                # The segment is new, so its contents are garbage by
+                # contract (the touch writes zeros).
+                try:
+                    from dlrover_tpu import native as dlrtpu_native
+
+                    dlrtpu_native.prefault(self._shm.buf)
+                except Exception:  # noqa: BLE001 - prefault is an
+                    # optimization; the copy path faults pages in anyway
+                    pass
 
     def attach(self) -> bool:
         """Attach to an existing segment (agent side)."""
@@ -234,37 +261,71 @@ def manifest_filename(host_rank: int) -> str:
     return f"host_{host_rank}.manifest.json"
 
 
+# Slack appended to the pickled-meta slot so the header's byte length
+# is fixed BEFORE the streaming crc lands in it: pickle ignores bytes
+# after the STOP opcode, and an int's pickled width varies by value
+# (BININT1 through LONG1 across the crc range) by at most a few bytes.
+_META_CRC_SLACK = 16
+
+
 def write_host_shard(
     storage, path: str, meta: CheckpointMeta, data
 ) -> tuple[int, int]:
     """Stream header + meta + payload; ``data`` may be a memoryview into
     shm — never copy the (multi-GB) payload into an intermediate blob.
 
-    The payload CRC (native libdlrtpu crc32, zlib fallback) is stamped
-    into the meta so restores detect torn or bit-rotted shard files.
-    Returns (payload_crc, payload_nbytes) — the INTENDED values, stamped
-    into the sidecar manifest before any fault (chaos tear/bitflip, a
-    real crash mid-write) can corrupt the on-disk bytes."""
+    The payload CRC is stamped into the meta so restores detect torn or
+    bit-rotted shard files. It is computed chunk-wise DURING the payload
+    write (one traversal: the checksum of chunk i overlaps the disk
+    write of chunks <= i) instead of in a pre-pass over the whole
+    payload; the header lands last in the invisible temp file, its byte
+    length pinned up front by padding the pickled meta (readers stop at
+    pickle's STOP opcode, so the pad is compatible with every existing
+    reader). Returns (payload_crc, payload_nbytes) — the INTENDED
+    values, stamped into the sidecar manifest before any fault (chaos
+    tear/bitflip, a real crash mid-write) can corrupt the on-disk
+    bytes."""
     from dlrover_tpu import native as dlrtpu_native
 
-    meta.payload_crc = dlrtpu_native.crc32(data)
     payload_nbytes = (
         data.nbytes if isinstance(data, memoryview) else len(data)
     )
     # fault site: tear (truncate mid-shard) or bit-flip the persisted
-    # payload AFTER the crc was computed — exactly what a preemption or
-    # bit-rot does to a real file
-    data = chaos_transform("ckpt.write", data, step=meta.step, path=path)
-    meta_bytes = pickle.dumps(meta)
-    storage.write_parts(
-        [
-            len(meta_bytes).to_bytes(_META_LEN_SIZE, "little"),
-            meta_bytes,
-            data,
-        ],
-        path,
+    # payload — the crc must describe the INTENDED bytes while the
+    # corrupted ones hit the disk, so a fired transform forces the
+    # two-pass shape (crc over the original, write the corrupted)
+    transformed = chaos_transform(
+        "ckpt.write", data, step=meta.step, path=path
     )
-    return meta.payload_crc, payload_nbytes
+    if transformed is not data:
+        meta.payload_crc = dlrtpu_native.crc32_parallel(data)
+        meta_bytes = pickle.dumps(meta)
+        storage.write_parts(
+            [
+                len(meta_bytes).to_bytes(_META_LEN_SIZE, "little"),
+                meta_bytes,
+                transformed,
+            ],
+            path,
+        )
+        return meta.payload_crc, payload_nbytes
+
+    meta.payload_crc = 0
+    meta_len = len(pickle.dumps(meta)) + _META_CRC_SLACK
+
+    def make_header(crc: int) -> bytes:
+        meta.payload_crc = crc
+        meta_bytes = pickle.dumps(meta)
+        assert len(meta_bytes) <= meta_len, "crc widened meta past slack"
+        meta_bytes += b"\x00" * (meta_len - len(meta_bytes))
+        return (
+            meta_len.to_bytes(_META_LEN_SIZE, "little") + meta_bytes
+        )
+
+    crc = storage.write_payload_with_header(
+        path, _META_LEN_SIZE + meta_len, make_header, data
+    )
+    return crc, payload_nbytes
 
 
 def write_shard_manifest(
@@ -288,20 +349,27 @@ def write_shard_manifest(
     storage.write(blob, os.path.join(step_dir, manifest_filename(shard_id)))
 
 
+_READ_CHUNK = 8 << 20
+
+
 def _file_payload_crc(path: str, payload_start: int) -> tuple[int, int]:
-    """(crc32, nbytes) of the payload region, chunked (bounded memory)."""
+    """(crc32, nbytes) of the payload region, chunked (bounded memory).
+    The chunk buffer comes from the host arena and is read INTO, so a
+    full-checkpoint verify allocates nothing per chunk."""
     from dlrover_tpu import native as dlrtpu_native
+    from dlrover_tpu.common.arena import get_arena
 
     crc = 0
     nbytes = 0
-    with open(path, "rb") as f:
+    with get_arena().lease(_READ_CHUNK) as lease, open(path, "rb") as f:
+        buf = lease.view
         f.seek(payload_start)
         while True:
-            chunk = f.read(8 << 20)
-            if not chunk:
+            got = f.readinto(buf)
+            if not got:
                 break
-            crc = dlrtpu_native.crc32(chunk, crc)
-            nbytes += len(chunk)
+            crc = dlrtpu_native.crc32(buf[:got], crc)
+            nbytes += got
     return crc, nbytes
 
 
@@ -501,28 +569,73 @@ def read_host_shard_meta(
     return meta, _META_LEN_SIZE + meta_len
 
 
-def read_host_shard(path: str) -> tuple[CheckpointMeta, bytes] | None:
+def read_host_shard(
+    path: str, stats: dict | None = None
+) -> tuple[CheckpointMeta, memoryview] | None:
+    """Read one ``.dlck`` shard: chunked ``readinto`` with the CRC
+    verified INCREMENTALLY on each chunk as it lands — one traversal,
+    transient memory beyond the returned payload stays O(chunk) (the
+    old shape ``f.read(total)`` + a second full CRC pass doubled the
+    passes and spiked peak RSS on multi-GB shards). Torn headers and
+    short payloads are rejected exactly like before.
+
+    Returns (meta, payload) where payload is a READ-ONLY memoryview
+    (callers build numpy views over it, as with the old ``bytes``).
+    ``stats`` (optional) accumulates ``read_s``/``verify_s``/``bytes``
+    for the staged restore breakdown."""
     if not os.path.exists(path):
         return None
+    from dlrover_tpu import native as dlrtpu_native
+
     try:
         with open(path, "rb") as f:
             meta_len = int.from_bytes(f.read(_META_LEN_SIZE), "little")
             meta = pickle.loads(f.read(meta_len))
-            data = f.read(meta.total_bytes)
+            # uninitialized allocation: bytearray(n) would memset the
+            # whole multi-GB buffer to zero just for readinto to
+            # overwrite it — a full extra memory-bandwidth pass
+            import numpy as _np
+
+            mv = memoryview(_np.empty(meta.total_bytes, _np.uint8))
+            crc = 0
+            filled = 0
+            check = meta.payload_crc >= 0
+            while filled < meta.total_bytes:
+                t0 = time.perf_counter()
+                got = f.readinto(
+                    mv[filled : filled + _READ_CHUNK]
+                )
+                t1 = time.perf_counter()
+                if not got:
+                    break
+                if check:
+                    crc = dlrtpu_native.crc32(
+                        mv[filled : filled + got], crc
+                    )
+                if stats is not None:
+                    stats["read_s"] = stats.get("read_s", 0.0) + (t1 - t0)
+                    stats["verify_s"] = stats.get("verify_s", 0.0) + (
+                        time.perf_counter() - t1
+                    )
+                filled += got
     except Exception:  # noqa: BLE001 - torn header/meta region
         logger.error("unreadable shard meta in %s; rejecting", path)
         return None
-    if meta.payload_crc >= 0:
-        from dlrover_tpu import native as dlrtpu_native
-
-        actual = dlrtpu_native.crc32(data)
-        if actual != meta.payload_crc:
-            logger.error(
-                "checksum mismatch reading %s (want %08x got %08x); "
-                "rejecting shard", path, meta.payload_crc, actual,
-            )
-            return None
-    return meta, data
+    if filled < meta.total_bytes:
+        logger.error(
+            "torn payload in %s (%d of %d bytes); rejecting shard",
+            path, filled, meta.total_bytes,
+        )
+        return None
+    if check and crc != meta.payload_crc:
+        logger.error(
+            "checksum mismatch reading %s (want %08x got %08x); "
+            "rejecting shard", path, meta.payload_crc, crc,
+        )
+        return None
+    if stats is not None:
+        stats["bytes"] = stats.get("bytes", 0) + meta.total_bytes
+    return meta, mv.toreadonly()
 
 
 # --------------------------------------------------------------------------
@@ -602,6 +715,14 @@ class AsyncCheckpointSaver:
         ]
         self._event_queues = [
             SharedQueue(event_queue_name(i), create=True)
+            for i in range(local_shard_num)
+        ]
+        # persist-completion wakeups (bounded: a slow/absent consumer
+        # must not grow agent memory — stale hints are droppable, the
+        # tracker file is the source of truth)
+        self._done_queues = [
+            SharedQueue(persist_done_queue_name(i), create=True,
+                        maxsize=64)
             for i in range(local_shard_num)
         ]
         self._stopped = threading.Event()
@@ -795,6 +916,14 @@ class AsyncCheckpointSaver:
         finally:
             if acquired:
                 lock.release(force=True)
+        # wake any engine blocked in wait_for_persist / the trainer's
+        # final-save retry loop: best-effort, non-blocking (a full queue
+        # just means the waiter is behind on hints; the tracker file
+        # still carries the truth)
+        try:
+            self._done_queues[local_rank].put(meta.step, block=False)
+        except Exception:  # noqa: BLE001 - hint only
+            pass
         elapsed = time.time() - start
         # timeline only: the daemon's persist overlaps training, so the
         # goodput ledger deliberately does NOT treat it as lost time

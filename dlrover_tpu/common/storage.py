@@ -2,7 +2,11 @@
 
 Equivalent capability: reference dlrover/python/common/storage.py
 (CheckpointStorage ABC :23, PosixDiskStorage :127,
-KeepStepIntervalStrategy :202, KeepLatestStepStrategy :230).
+KeepStepIntervalStrategy :202, KeepLatestStepStrategy :230) — plus the
+chunked/parallel read-write primitives the pipelined persist path uses
+(bounded writer pool, positional chunk writes, header-after-payload
+streaming so a CRC computed DURING the write can still land in a header
+that precedes the payload on disk).
 """
 
 from __future__ import annotations
@@ -11,11 +15,47 @@ import os
 import shutil
 import threading
 from abc import ABC, abstractmethod
+from concurrent.futures import ThreadPoolExecutor
 
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
+
+# Bounded process-wide writer pool shared by every storage instance: the
+# saver daemon runs one persist thread per local shard, and each shard's
+# chunk writes fan out here — DLROVER_TPU_CKPT_WRITE_THREADS bounds the
+# TOTAL disk-writer concurrency, not per-shard.
+_WRITE_POOL: ThreadPoolExecutor | None = None
+_WRITE_POOL_LOCK = threading.Lock()
+WRITE_CHUNK_BYTES = 32 << 20
+
+
+def _write_pool() -> ThreadPoolExecutor:
+    global _WRITE_POOL
+    if _WRITE_POOL is None:
+        with _WRITE_POOL_LOCK:
+            if _WRITE_POOL is None:
+                raw = os.environ.get("DLROVER_TPU_CKPT_WRITE_THREADS", "")
+                try:
+                    n = int(raw) if raw else 0
+                except ValueError:
+                    n = 0
+                if n <= 0:
+                    n = min(4, os.cpu_count() or 1)
+                _WRITE_POOL = ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="ckpt-write"
+                )
+    return _WRITE_POOL
+
+
+def _chunk_views(data, chunk_bytes: int):
+    """Zero-copy chunk views over a byte-like payload."""
+    mv = memoryview(data)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    for off in range(0, len(mv), chunk_bytes):
+        yield off, mv[off : off + chunk_bytes]
 
 
 class CheckpointDeletionStrategy(ABC):
@@ -118,6 +158,32 @@ class CheckpointStorage(ABC):
         concatenating them in memory (multi-GB checkpoint payloads)."""
         self.write(b"".join(bytes(p) for p in parts), path)
 
+    def write_payload_with_header(
+        self,
+        path: str,
+        header_size: int,
+        make_header,
+        payload,
+        chunk_bytes: int = WRITE_CHUNK_BYTES,
+    ) -> int:
+        """Write ``[header][payload]`` where the header bytes depend on
+        a streaming CRC of the payload. ``make_header(crc) -> bytes`` of
+        EXACTLY ``header_size``. Returns the payload crc.
+
+        Base implementation keeps the two-pass shape (crc pass over the
+        in-memory payload, then a sequential write); backends with
+        positional writes overlap the CRC with the payload writes and
+        patch the header in last (the file only becomes visible after
+        its atomic publish, so in-file write order is free).
+        """
+        from dlrover_tpu import native as dlrtpu_native
+
+        crc = 0
+        for _off, chunk in _chunk_views(payload, chunk_bytes):
+            crc = dlrtpu_native.crc32(chunk, crc)
+        self.write_parts([make_header(crc), payload], path)
+        return crc
+
     @abstractmethod
     def read(self, path: str, mode: str = "r"):
         ...
@@ -161,15 +227,97 @@ class PosixDiskStorage(CheckpointStorage):
             os.fsync(f.fileno())
         os.replace(tmp, path)
 
+    # parts at/above this size get chunked positional writes through the
+    # bounded writer pool; small parts stay on the sequential fast path
+    _PARALLEL_PART_BYTES = 64 << 20
+
     def write_parts(self, parts, path: str):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
+        parts = list(parts)
+        large = any(
+            getattr(p, "nbytes", len(p)) >= self._PARALLEL_PART_BYTES
+            for p in parts
+        )
         with open(tmp, "wb") as f:
-            for part in parts:
-                f.write(part)
+            if large:
+                self._write_parts_positional(f, parts)
+            else:
+                for part in parts:
+                    f.write(part)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+
+    @staticmethod
+    def _write_parts_positional(f, parts):
+        """Chunk-parallel pwrite of the large parts (zero-copy views
+        into e.g. the shm segment); byte-identical to the sequential
+        path — only the in-file write ORDER differs, which is invisible
+        behind the atomic rename."""
+        fd = f.fileno()
+        offsets = []
+        off = 0
+        for p in parts:
+            offsets.append(off)
+            off += getattr(p, "nbytes", len(p))
+        f.truncate(off)
+        futures = []
+        pool = _write_pool()
+        for p, start in zip(parts, offsets):
+            for rel, chunk in _chunk_views(p, WRITE_CHUNK_BYTES):
+                futures.append(
+                    pool.submit(os.pwrite, fd, chunk, start + rel)
+                )
+        for fut in futures:
+            fut.result()  # surface write errors (ENOSPC, EIO)
+
+    def write_payload_with_header(
+        self,
+        path: str,
+        header_size: int,
+        make_header,
+        payload,
+        chunk_bytes: int = WRITE_CHUNK_BYTES,
+    ) -> int:
+        """Single-pass persist: payload chunks stream to disk through
+        the writer pool while the running CRC is computed over the same
+        chunks (zlib releases the GIL, so the checksum of chunk i
+        overlaps the pwrite of chunks <= i); the header — which embeds
+        the final crc — lands last at offset 0. The tmp file only
+        becomes the real file after fsync + atomic rename, so a reader
+        can never observe the header-less intermediate."""
+        from dlrover_tpu import native as dlrtpu_native
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        crc = 0
+        with open(tmp, "wb") as f:
+            fd = f.fileno()
+            mv = memoryview(payload)
+            if mv.ndim != 1 or mv.itemsize != 1:
+                mv = mv.cast("B")
+            f.truncate(header_size + len(mv))
+            pool = _write_pool()
+            futures = []
+            for off, chunk in _chunk_views(mv, chunk_bytes):
+                futures.append(
+                    pool.submit(os.pwrite, fd, chunk, header_size + off)
+                )
+                crc = dlrtpu_native.crc32(chunk, crc)
+            for fut in futures:
+                fut.result()
+            header = make_header(crc)
+            if len(header) != header_size:
+                raise ValueError(
+                    f"make_header returned {len(header)} bytes, "
+                    f"promised {header_size}"
+                )
+            os.pwrite(fd, header, 0)
+            f.flush()
+            os.fsync(fd)
+        os.replace(tmp, path)
+        return crc
 
     def read(self, path: str, mode: str = "r"):
         if not os.path.exists(path):

@@ -332,6 +332,12 @@ EVENT_CATEGORY = {
     "ckpt.save": "checkpoint",
     "ckpt.persist.wait": "checkpoint",
     "ckpt.restore": "restart",
+    # the restore pipeline's blocking device-transfer barrier: without
+    # its own (checkpoint-priority) interval the multi-minute H2D wait
+    # of a standalone restore would sweep into ``idle``; inside a full
+    # ckpt.restore interval it claims checkpoint over the coarser
+    # restart attribution, so the transfer leg stays visible
+    "ckpt.restore.h2d": "checkpoint",
     "rdzv.wait": "rendezvous",
 }
 
@@ -614,6 +620,14 @@ def format_report(report: dict, timeline_tail: int = 40) -> str:
         for c in counters:
             label_s = ",".join(f"{k}={v}" for k, v in c["labels"].items())
             lines.append(f"{c['value']:10.0f}  {c['name']}"
+                         + (f"{{{label_s}}}" if label_s else ""))
+    gauges = metrics.get("gauges", [])
+    if gauges:
+        lines.append("")
+        lines.append("=== gauges ===")
+        for g in gauges:
+            label_s = ",".join(f"{k}={v}" for k, v in g["labels"].items())
+            lines.append(f"{g['value']:14.3f}  {g['name']}"
                          + (f"{{{label_s}}}" if label_s else ""))
     hists = metrics.get("histograms", [])
     if hists:

@@ -10,7 +10,13 @@ the framework works without a toolchain.
 Surface:
 - :func:`scatter_copy` — multi-threaded GIL-released scatter memcpy for
   the flash-checkpoint HBM->shm hot path
+- :func:`gather_copy` — the restore counterpart: threaded copy OUT of one
+  big buffer (shm segment) into scattered destination arrays
 - :func:`crc32` — zlib-compatible checksum (always zlib; see docstring)
+- :func:`crc32_combine` / :func:`crc32_parallel` — GF(2) chunk-CRC merge
+  and the combine-based threaded CRC built on it (zlib lacks both)
+- :func:`prefault` — threaded page touch for fresh shm segments (the
+  cold-save fault-in tax, paid across cores)
 - :class:`TimerRing` — shared-memory timing ring (xpu_timer analogue)
 """
 
@@ -90,6 +96,28 @@ def _bind(lib):
         ctypes.c_int,
     ]
     lib.dlrtpu_scatter_copy.restype = None
+    # GatherSeg has the same {ptr, u64, u64} layout as CopySeg
+    lib.dlrtpu_gather_copy.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_CopySeg), ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.dlrtpu_gather_copy.restype = None
+    lib.dlrtpu_prefault.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.dlrtpu_prefault.restype = None
+    lib.dlrtpu_crc32.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+    ]
+    lib.dlrtpu_crc32.restype = ctypes.c_uint32
+    lib.dlrtpu_crc32_combine.argtypes = [
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
+    ]
+    lib.dlrtpu_crc32_combine.restype = ctypes.c_uint32
+    lib.dlrtpu_crc32_parallel.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int,
+    ]
+    lib.dlrtpu_crc32_parallel.restype = ctypes.c_uint32
     lib.dlrtpu_ring_bytes.argtypes = [ctypes.c_uint64]
     lib.dlrtpu_ring_bytes.restype = ctypes.c_uint64
     lib.dlrtpu_ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -118,15 +146,36 @@ def get_lib():
         if os.environ.get("DLROVER_TPU_DISABLE_NATIVE"):
             return None
         try:
-            if not os.path.exists(_LIB_PATH):
-                if not _try_build():
+            if not os.path.exists(_LIB_PATH) or _lib_stale():
+                if not _try_build() and not os.path.exists(_LIB_PATH):
                     return None
-            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+            lib = ctypes.CDLL(_LIB_PATH)
+            if not hasattr(lib, "dlrtpu_gather_copy"):
+                # prebuilt .so from an older source without the restore-
+                # path symbols: rebuild and reload (os.replace swapped
+                # the inode, so CDLL picks up the fresh file)
+                if not _try_build():
+                    logger.warning(
+                        "libdlrtpu is stale and rebuild failed; "
+                        "using fallbacks"
+                    )
+                    return None
+                lib = ctypes.CDLL(_LIB_PATH)
+            _lib = _bind(lib)
             logger.info("libdlrtpu loaded from %s", _LIB_PATH)
-        except OSError as e:
+        except (OSError, AttributeError) as e:
             logger.warning("libdlrtpu load failed (%s); using fallbacks", e)
             _lib = None
     return _lib
+
+
+def _lib_stale() -> bool:
+    """True when the source is newer than the cached build."""
+    src = os.path.join(_SRC_DIR, "dlrtpu.cc")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
 
 
 def native_available() -> bool:
@@ -171,6 +220,69 @@ def scatter_copy(dst_buf, parts, nthreads: int = 8) -> bool:
     return True
 
 
+def gather_copy(src_buf, parts, nthreads: int = 8) -> bool:
+    """Copy ``parts`` = [(src_offset, dst_ndarray), ...] OUT of
+    ``src_buf`` (e.g. the shm checkpoint segment) into the destination
+    arrays — the restore counterpart of :func:`scatter_copy`. Returns
+    True if the native path ran; False means the caller must fall back.
+
+    Destinations must be C-contiguous and writable (the caller owns
+    allocation so restored leaves never alias pooled memory)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None or not parts:
+        return lib is not None
+    src_mv = memoryview(src_buf)
+    if src_mv.ndim != 1 or src_mv.itemsize != 1:
+        src_mv = src_mv.cast("B")
+    segs = (_CopySeg * len(parts))()
+    keepalive = []
+    for i, (offset, arr) in enumerate(parts):
+        if not isinstance(arr, np.ndarray):
+            raise TypeError("gather_copy destinations must be ndarrays")
+        flat = arr.view(np.uint8).reshape(-1)
+        if not flat.flags["C_CONTIGUOUS"] or not flat.flags["WRITEABLE"]:
+            raise ValueError(
+                "gather_copy destination must be contiguous and writable"
+            )
+        if int(offset) + flat.nbytes > len(src_mv):
+            raise ValueError(
+                f"gather_copy overrun: offset {offset} + {flat.nbytes} "
+                f"bytes exceeds source of {len(src_mv)}"
+            )
+        keepalive.append(flat)
+        segs[i].src = flat.ctypes.data  # dst pointer (GatherSeg layout)
+        segs[i].dst_offset = int(offset)  # src offset
+        segs[i].size = flat.nbytes
+    # resolve the source base address without copying: ctypes
+    # from_buffer refuses read-only buffers, but a numpy view over the
+    # same memory exposes the data pointer either way
+    src_arr = np.frombuffer(src_mv, dtype=np.uint8)
+    keepalive.append(src_arr)
+    lib.dlrtpu_gather_copy(
+        src_arr.ctypes.data, segs, len(parts), int(nthreads)
+    )
+    return True
+
+
+def prefault(buf, nthreads: int = 8) -> bool:
+    """Fault in a FRESH writable buffer's pages across threads (writes a
+    zero byte per page — caller guarantees the contents are garbage).
+    Returns False when the native lib is unavailable (no fallback: a
+    single-threaded pre-touch just moves the same cost around)."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    n = len(buf)
+    if n == 0:
+        return True
+    base = (ctypes.c_char * n).from_buffer(buf)
+    lib.dlrtpu_prefault(ctypes.addressof(base), n, int(nthreads))
+    del base
+    return True
+
+
 # ----------------------------------------------------------------- crc32
 
 
@@ -180,10 +292,90 @@ def crc32(data, seed: int = 0) -> int:
     Always zlib: its slice-by-N implementation is ~5x faster than a
     byte-at-a-time C table loop and already releases the GIL, so a
     "native" path here would be a pessimization on multi-GB shards
-    (measured: 64 MiB in 0.033s zlib vs 0.170s table-loop)."""
+    (measured: 64 MiB in 0.033s zlib vs 0.170s table-loop). The seed
+    argument chains chunk CRCs, which is what the streaming read/write
+    paths use; :func:`crc32_parallel` fans large in-memory payloads
+    across threads via the native combine."""
     import zlib
 
     return zlib.crc32(data, seed) & 0xFFFFFFFF
+
+
+def crc32_combine(crc1: int, crc2: int, len2: int) -> int:
+    """crc(A+B) from crc(A), crc(B), len(B) — zlib's crc32_combine,
+    which the Python zlib module does not expose. Native when available,
+    pure-Python GF(2) fallback otherwise (small fixed cost, no payload
+    pass either way)."""
+    if len2 == 0:
+        return crc1 & 0xFFFFFFFF
+    lib = get_lib()
+    if lib is not None:
+        return int(
+            lib.dlrtpu_crc32_combine(crc1 & 0xFFFFFFFF, crc2 & 0xFFFFFFFF,
+                                     len2)
+        )
+    return _py_crc32_combine(crc1, crc2, len2)
+
+
+def _gf2_times(mat, vec):
+    s = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            s ^= mat[i]
+        vec >>= 1
+        i += 1
+    return s
+
+
+def _py_crc32_combine(crc1: int, crc2: int, len2: int) -> int:
+    odd = [0] * 32
+    odd[0] = 0xEDB88320
+    row = 1
+    for n in range(1, 32):
+        odd[n] = row
+        row <<= 1
+    even = [_gf2_times(odd, odd[n]) for n in range(32)]
+    odd = [_gf2_times(even, even[n]) for n in range(32)]
+    crc1 &= 0xFFFFFFFF
+    while True:
+        even = [_gf2_times(odd, odd[n]) for n in range(32)]
+        if len2 & 1:
+            crc1 = _gf2_times(even, crc1)
+        len2 >>= 1
+        if len2 == 0:
+            break
+        odd = [_gf2_times(even, even[n]) for n in range(32)]
+        if len2 & 1:
+            crc1 = _gf2_times(odd, crc1)
+        len2 >>= 1
+        if len2 == 0:
+            break
+    return (crc1 ^ crc2) & 0xFFFFFFFF
+
+
+def crc32_parallel(data, seed: int = 0, nthreads: int = 8) -> int:
+    """CRC-32 of a large in-memory payload, chunked across threads and
+    merged with crc32_combine. Falls back to sequential zlib (identical
+    result) when the native lib is unavailable or the payload is too
+    small for threading to pay."""
+    mv = memoryview(data)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    n = len(mv)
+    min_chunk = 8 << 20
+    lib = get_lib()
+    if lib is None or n < 2 * min_chunk or nthreads <= 1:
+        return crc32(mv, seed)
+    import numpy as np
+
+    # numpy view exposes the data pointer for read-only buffers too
+    arr = np.frombuffer(mv, dtype=np.uint8)
+    return int(
+        lib.dlrtpu_crc32_parallel(
+            arr.ctypes.data, n, seed & 0xFFFFFFFF, int(nthreads)
+        )
+    )
 
 
 # ------------------------------------------------------------ timer ring

@@ -378,16 +378,24 @@ class Trainer:
             # persist still holding the shm lock: a silently skipped
             # save here would strand wait_for_persist on a step that
             # never arrives and drop the end-of-run state entirely.
-            # Bounded retry until the in-flight persist drains.
+            # Bounded retry until the in-flight persist drains —
+            # EVENT-DRIVEN: each retry blocks on the saver's persist-
+            # done queue (the lock holder is an in-flight persist, so
+            # its completion is exactly the wakeup we need) with the
+            # deadline as backstop, instead of quantizing end-of-run
+            # latency to a fixed poll interval.
             deadline = time.time() + 120
             while not self.save_checkpoint(persist=True):
-                if time.time() >= deadline:
+                remaining = deadline - time.time()
+                if remaining <= 0:
                     logger.error(
                         "final checkpoint save at step %d kept getting "
                         "skipped; giving up", self.global_step,
                     )
                     break
-                time.sleep(0.2)
+                self._engine.wait_for_persist_progress(
+                    min(remaining, 2.0)
+                )
             else:
                 t_wait = time.monotonic()
                 self._engine.wait_for_persist(

@@ -59,6 +59,47 @@ class TestScatterCopy:
         assert bytes(dst) == np.ascontiguousarray(view).tobytes()
 
 
+class TestGatherCopy:
+    def test_matches_source(self):
+        require_native()
+        src = bytearray(os.urandom(100_000))
+        d1 = np.zeros(40_000, np.uint8)
+        d2 = np.zeros((100, 100), np.float32)  # 40_000 bytes
+        assert native.gather_copy(src, [(0, d1), (50_000, d2)])
+        assert bytes(d1) == bytes(src[:40_000])
+        assert d2.tobytes() == bytes(src[50_000:90_000])
+
+    def test_readonly_source(self):
+        require_native()
+        src = bytes(os.urandom(4096))
+        dst = np.zeros(1024, np.uint8)
+        assert native.gather_copy(src, [(100, dst)])
+        assert bytes(dst) == src[100:1124]
+
+    def test_overrun_raises(self):
+        require_native()
+        src = bytearray(100)
+        with pytest.raises(ValueError):
+            native.gather_copy(src, [(90, np.zeros(20, np.uint8))])
+
+    def test_large_multithreaded(self):
+        require_native()
+        src = bytearray(os.urandom(24 << 20))
+        dst = np.zeros(20 << 20, np.uint8)
+        assert native.gather_copy(src, [(1 << 20, dst)], nthreads=4)
+        assert dst.tobytes() == bytes(src[1 << 20 : 21 << 20])
+
+
+class TestPrefault:
+    def test_prefault_zeroes_page_heads(self):
+        require_native()
+        buf = bytearray(b"\xff" * (64 << 10))
+        assert native.prefault(buf)
+        # one byte per 4 KiB page written to zero; the rest untouched
+        assert buf[0] == 0 and buf[4096] == 0
+        assert buf[1] == 0xFF
+
+
 class TestCrc32:
     def test_matches_zlib(self):
         require_native()
@@ -71,6 +112,38 @@ class TestCrc32:
         part = native.crc32(data[:2000])
         full = native.crc32(data[2000:], seed=part)
         assert full == zlib.crc32(data) & 0xFFFFFFFF
+
+    def test_combine_matches_streaming(self):
+        data = os.urandom(9001)
+        cut = 4000
+        a = native.crc32(data[:cut])
+        b = native.crc32(data[cut:])
+        assert (
+            native.crc32_combine(a, b, len(data) - cut)
+            == native.crc32(data)
+        )
+        # pure-python combine agrees with the native one
+        assert (
+            native._py_crc32_combine(a, b, len(data) - cut)
+            == native.crc32(data)
+        )
+
+    def test_combine_zero_len(self):
+        assert native.crc32_combine(0x12345678, 0, 0) == 0x12345678
+
+    def test_parallel_matches_sequential(self):
+        require_native()
+        data = os.urandom(20 << 20)
+        assert native.crc32_parallel(data, nthreads=4) == native.crc32(
+            data
+        )
+        assert native.crc32_parallel(
+            data, seed=77, nthreads=4
+        ) == native.crc32(data, seed=77)
+
+    def test_parallel_small_falls_back(self):
+        data = os.urandom(1000)
+        assert native.crc32_parallel(data) == native.crc32(data)
 
 
 class TestTimerRing:

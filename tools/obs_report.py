@@ -55,6 +55,7 @@ def build_report(
     report = jt.report(now=now)
     # raw snapshots are an input detail, not operator output
     report.pop("snapshots", None)
+    report["restore"] = _restore_summary(report.get("metrics", {}))
     if trace_dir:
         try:
             from tools.parse_profile import summarize
@@ -66,6 +67,21 @@ def build_report(
             # take the goodput report down with it
             report["profile_error"] = f"trace parse failed: {e}"
     return report
+
+
+def _restore_summary(metrics: dict) -> dict:
+    """Checkpoint data-path health at a glance: the staged restore
+    pipeline's per-leg throughput gauges (read / verify / h2d), the
+    save fill leg, and host-arena reuse counters."""
+    out: dict = {}
+    for g in metrics.get("gauges", ()):
+        if g["name"].startswith(("ckpt.restore.", "ckpt.save.fill",
+                                 "ckpt.arena.")):
+            out[g["name"]] = g["value"]
+    for c in metrics.get("counters", ()):
+        if c["name"].startswith("ckpt.arena."):
+            out[c["name"]] = c["value"]
+    return out
 
 
 def main(argv=None) -> int:
@@ -109,6 +125,11 @@ def main(argv=None) -> int:
         from dlrover_tpu.common.telemetry import format_report
 
         print(format_report(report, timeline_tail=args.timeline))
+        restore = report.get("restore") or {}
+        if restore:
+            print("\n=== checkpoint data path ===")
+            for name in sorted(restore):
+                print(f"{restore[name]:14.3f}  {name}")
         if report.get("profile_error"):
             print(f"\n[profile skipped: {report['profile_error']}]",
                   file=sys.stderr)
