@@ -14,22 +14,57 @@ import threading
 import time
 
 from dlrover_tpu.common import messages as msg
-from dlrover_tpu.common.constants import NodeEnv, NodeType
+from dlrover_tpu.common.constants import (
+    JobConstant,
+    NodeEnv,
+    NodeType,
+    RendezvousName,
+)
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import RpcClient
 
 logger = get_logger(__name__)
+
+# Ride-through knobs (agent-side master-failover tolerance).
+ENV_RIDE_THROUGH = "DLROVER_MASTER_RIDE_THROUGH"  # seconds
+ENV_RIDE_POLL = "DLROVER_MASTER_RIDE_POLL"        # probe interval
+
+
+def resolve_master_addr(default: str = "") -> str:
+    """The master's CURRENT address: the address file (written by
+    ``master.main --addr-file``, atomically re-written on restart) wins
+    over the launch-time env var, which wins over ``default``."""
+    path = os.environ.get(NodeEnv.DLROVER_MASTER_ADDR_FILE, "")
+    if path:
+        try:
+            with open(path) as f:
+                addr = f.read().strip()
+            if addr:
+                return addr
+        except OSError:
+            pass
+    return os.environ.get(NodeEnv.DLROVER_MASTER_ADDR, "") or default
 
 
 class MasterClient:
     _instance = None
     _instance_lock = threading.Lock()
 
-    def __init__(self, master_addr: str, node_id: int, node_type: str):
+    def __init__(
+        self, master_addr: str, node_id: int, node_type: str,
+        addr_resolver=None,
+    ):
         self._addr = master_addr
         self._node_id = node_id
         self._node_type = node_type
-        self._rpc = RpcClient(master_addr)
+        self._rpc = RpcClient(
+            master_addr,
+            addr_resolver=(
+                addr_resolver
+                if addr_resolver is not None
+                else lambda: resolve_master_addr(master_addr)
+            ),
+        )
         self._host = socket.gethostname()
         try:
             self._host_ip = socket.gethostbyname(self._host)
@@ -40,7 +75,12 @@ class MasterClient:
 
     @property
     def master_addr(self) -> str:
-        return self._addr
+        # the RpcClient's view: follows resolver-driven re-resolution
+        return self._rpc.addr
+
+    @property
+    def host_ip(self) -> str:
+        return self._host_ip
 
     @property
     def node_id(self) -> int:
@@ -61,6 +101,37 @@ class MasterClient:
 
     def close(self):
         self._rpc.close()
+
+    # ------------------------------------------------- master ride-through
+
+    def await_master(
+        self, timeout: float | None = None, poll: float | None = None
+    ) -> bool:
+        """Bounded ride-through for an unreachable master.
+
+        Ordinary RPC exhaustion (a reachable master answering with
+        errors) surfaces as RuntimeError and is NOT what this handles;
+        this is for transport-level loss — the coordinator died or
+        moved. Each probe closes the cached socket so the next connect
+        re-resolves the address (env / address file), then pings.
+        Returns True the moment the master (old or restarted) answers;
+        False when the budget runs out — the caller decides whether to
+        keep training and retry or give up."""
+        if timeout is None:
+            timeout = float(os.environ.get(
+                ENV_RIDE_THROUGH,
+                str(JobConstant.MASTER_RIDE_THROUGH_DEFAULT),
+            ))
+        if poll is None:
+            poll = float(os.environ.get(ENV_RIDE_POLL, "2.0"))
+        deadline = time.monotonic() + timeout
+        while True:
+            self._rpc.close()  # force re-resolve + reconnect
+            if self.ping():
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(min(poll, max(deadline - time.monotonic(), 0.0)))
 
     # ------------------------------------------------------- data sharding
 
@@ -128,6 +199,22 @@ class MasterClient:
                 verified_ckpt_step=verified_ckpt_step,
                 verified_ckpt_steps=list(verified_ckpt_steps or ()),
             )
+        )
+
+    def report_verified_steps(
+        self, node_rank: int, steps,
+        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+    ) -> bool:
+        """Refresh this node's restorable-step set without joining —
+        used when re-registering after a master failover (a join would
+        dissolve the restored round and restart healthy workers)."""
+        return self._report(
+            msg.VerifiedStepsReport(
+                node_rank=node_rank,
+                rdzv_name=rdzv_name,
+                steps=[int(s) for s in (steps or ())],
+            ),
+            retries=2,
         )
 
     def get_comm_world(self, rdzv_name: str, node_rank: int):

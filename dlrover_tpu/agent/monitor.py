@@ -106,7 +106,16 @@ class ResourceMonitor:
 
 class HeartbeatReporter:
     """Agent heartbeat loop; the master's heartbeat-timeout monitor
-    declares the node dead if these stop arriving."""
+    declares the node dead if these stop arriving.
+
+    Tracks consecutive transport-level misses so the agent can tell a
+    dead/restarting MASTER (every heartbeat's whole retry budget
+    exhausted) from a transient blip, and enter its ride-through path
+    instead of letting workers discover the outage one RPC at a time."""
+
+    # misses before ``master_unreachable`` flips: each miss already
+    # burned a full RetryPolicy budget, so 2 in a row is a real outage
+    UNREACHABLE_MISSES = 2
 
     def __init__(self, master_client, interval=JobConstant.MONITOR_INTERVAL):
         self._client = master_client
@@ -114,6 +123,14 @@ class HeartbeatReporter:
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
         self.action = ""
+        self.misses = 0
+
+    @property
+    def master_unreachable(self) -> bool:
+        return self.misses >= self.UNREACHABLE_MISSES
+
+    def reset_misses(self):
+        self.misses = 0
 
     def start(self):
         self._thread = threading.Thread(
@@ -128,8 +145,11 @@ class HeartbeatReporter:
         while not self._stopped.is_set():
             try:
                 resp = self._client.report_heart_beat()
+                self.misses = 0
                 if resp.action:
                     self.action = resp.action
+            except (ConnectionError, OSError):
+                self.misses += 1
             except Exception:  # noqa: BLE001
                 pass
             self._stopped.wait(self._interval)
@@ -212,6 +232,12 @@ class TelemetryReporter:
         )
         # source -> last shipped (mtime, size): only changed files go out
         self._shipped: dict = {}
+
+    def reset_shipped(self):
+        """Forget what was shipped — after a master failover the new
+        incarnation's merge may predate snapshots this host already
+        sent, so re-send everything on the next tick."""
+        self._shipped = {}
 
     def start(self):
         threading.Thread(

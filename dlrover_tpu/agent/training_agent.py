@@ -99,6 +99,10 @@ class ElasticLaunchConfig:
     # brpc/Prometheus export): -1 = disabled (default: an HTTP listener
     # is opt-in), 0 = ephemeral port, >0 = fixed port
     metrics_port: int = -1
+    # how long one ride-through attempt waits for an unreachable master
+    # to come back before logging the outage as (still) lost; workers
+    # keep training either way and the agent re-probes on its next tick
+    master_ride_through: float = JobConstant.MASTER_RIDE_THROUGH_DEFAULT
 
     def auto_configure_params(self):
         """--auto-config: infer process count from visible devices."""
@@ -248,9 +252,18 @@ _DEVICE_ERROR_PATTERNS = (
 )
 
 
-def classify_exit(returncode: int, log_tail: str = "") -> str:
+def classify_exit(
+    returncode: int, log_tail: str = "", stopping: bool = False
+) -> str:
     if returncode == 0:
         return "succeeded"
+    if stopping and (
+        -returncode == signal.SIGTERM or returncode == ExitCode.TERMED
+    ):
+        # the AGENT sent that SIGTERM (stop/restart path): a worker
+        # dying of it is a clean stop, not a software failure — it must
+        # not burn a restart budget or be reported as a fault
+        return "stopped"
     if returncode in ExitCode.HARDWARE_ERRORS or -returncode in (
         signal.SIGABRT,
         signal.SIGBUS,
@@ -294,6 +307,9 @@ class ElasticTrainingAgent:
         self._timer_exporter = TimerRingExporter()
         self._log_files: list[str] = []
         self._ckpt_saver = None
+        # set while the agent itself is terminating workers, so their
+        # -SIGTERM exits classify as "stopped" instead of "software"
+        self._stopping = False
 
     # ----------------------------------------------------------- lifecycle
 
@@ -447,19 +463,23 @@ class ElasticTrainingAgent:
         )
 
     def _stop_workers(self, timeout: float = 30.0):
-        for w in self._workers:
-            if w.returncode is None:
-                w.proc.terminate()
-        deadline = time.time() + timeout
-        for w in self._workers:
-            if w.returncode is None:
-                remaining = max(deadline - time.time(), 0.1)
-                try:
-                    w.proc.wait(timeout=remaining)
-                except subprocess.TimeoutExpired:
-                    w.proc.kill()
-                    w.proc.wait()
-        self._workers = []
+        self._stopping = True
+        try:
+            for w in self._workers:
+                if w.returncode is None:
+                    w.proc.terminate()
+            deadline = time.time() + timeout
+            for w in self._workers:
+                if w.returncode is None:
+                    remaining = max(deadline - time.time(), 0.1)
+                    try:
+                        w.proc.wait(timeout=remaining)
+                    except subprocess.TimeoutExpired:
+                        w.proc.kill()
+                        w.proc.wait()
+            self._workers = []
+        finally:
+            self._stopping = False
 
     def _restart_workers(self):
         self._restart_count += 1
@@ -584,7 +604,9 @@ class ElasticTrainingAgent:
             if failed:
                 idx, code = failed[0]
                 tail = self._log_tail(idx)
-                kind = classify_exit(code, tail)
+                kind = classify_exit(code, tail, stopping=self._stopping)
+                if kind == "stopped":
+                    continue  # our own SIGTERM; the stop path finishes it
                 telemetry.event(
                     "worker.exit", local_rank=idx, rc=code,
                     exit_kind=kind, restart=self._restart_count,
@@ -592,11 +614,19 @@ class ElasticTrainingAgent:
                 logger.warning(
                     "worker %d exited rc=%s (%s)", idx, code, kind
                 )
-                self._client.report_failure(
-                    f"worker rc={code} kind={kind}: {tail[-1000:]}",
-                    TrainingExceptionLevel.PROCESS_ERROR,
-                    self._restart_count,
-                )
+                try:
+                    self._client.report_failure(
+                        f"worker rc={code} kind={kind}: {tail[-1000:]}",
+                        TrainingExceptionLevel.PROCESS_ERROR,
+                        self._restart_count,
+                    )
+                except (ConnectionError, OSError):
+                    # a worker death DURING a master outage must still
+                    # be handled locally; the report is best-effort
+                    logger.warning(
+                        "could not report worker failure (master "
+                        "unreachable)"
+                    )
                 if self._config.save_at_breakpoint:
                     self._save_ckpt_at_breakpoint()
                 if kind in ("software", "oom") and self._remaining_restarts <= 0:
@@ -611,7 +641,14 @@ class ElasticTrainingAgent:
                 self._remaining_restarts -= 1
                 self._restart_workers()
                 continue
-            # workers healthy: check membership changes
+            # workers healthy: probe the master cheaply (single-attempt
+            # ping) so a coordinator outage is detected and attributed
+            # promptly, instead of surfacing one exhausted retry budget
+            # at a time; the heartbeat's budget-exhaustion flag is the
+            # slow-path backstop
+            if self._heartbeat.master_unreachable or not self._client.ping():
+                self._ride_through_master_outage()
+            # check membership changes
             if self._membership_changed():
                 logger.info("membership changed; restarting workers")
                 self._restart_workers()
@@ -629,8 +666,80 @@ class ElasticTrainingAgent:
                 RendezvousName.ELASTIC_TRAINING
             )
             return waiting > 0
+        except (ConnectionError, OSError):
+            # master unreachable, not a membership change: ride through
+            # (workers keep training on their last formed world)
+            self._ride_through_master_outage()
+            return False
         except Exception:  # noqa: BLE001
             return False
+
+    # ------------------------------------------------- master ride-through
+
+    def _ride_through_master_outage(self):
+        """The master is gone (every retry budget exhausted). Workers
+        keep training — only data-plane collectives involve them, and
+        shard fetches ride their own retry policies — while this agent
+        polls for the master (old or restarted, re-resolving the
+        address each probe) and re-registers when it answers. Only a
+        GENUINE membership change reported by the restored master
+        triggers a worker restart, via the normal num_nodes_waiting
+        path after this returns."""
+        t0 = time.monotonic()
+        telemetry.event(
+            "master.unreachable", restart=self._restart_count
+        )
+        logger.warning(
+            "master unreachable at %s; riding through (workers keep "
+            "training)", self._client.master_addr,
+        )
+        ok = self._client.await_master(
+            timeout=self._config.master_ride_through
+        )
+        dur = time.monotonic() - t0
+        if not ok:
+            telemetry.event("master.lost", dur=dur)
+            logger.error(
+                "master still unreachable after %.0fs; workers keep "
+                "training, will re-probe next tick", dur,
+            )
+            return
+        # the outage interval: the goodput ledger charges it to the
+        # ``restart`` bucket (anything workers productively overlapped
+        # still wins by sweep priority)
+        telemetry.event(
+            "master.restart", dur=dur, addr=self._client.master_addr
+        )
+        logger.info(
+            "master back after %.1fs at %s; re-registering",
+            dur, self._client.master_addr,
+        )
+        self._heartbeat.reset_misses()
+        self._re_register()
+
+    def _re_register(self):
+        """Re-push the state a restored master may be missing: node
+        meta, the newest locally-restorable checkpoint steps (persists
+        during the outage aren't in its snapshot), and this host's
+        telemetry. Deliberately NOT a rendezvous join — that would
+        dissolve the restored round and restart healthy workers."""
+        try:
+            self._client.report_node_meta(
+                self._config.node_rank, addr=self._client.host_ip
+            )
+            self._client.report_verified_steps(
+                self._config.node_rank, self._restorable_steps()
+            )
+        except (ConnectionError, OSError):
+            logger.warning(
+                "post-outage re-registration failed; next tick retries"
+            )
+        except Exception:  # noqa: BLE001 - best-effort: a scan error
+            # must not take down a healthy agent
+            logger.warning("post-outage re-registration error",
+                           exc_info=True)
+        self._telemetry_reporter.reset_shipped()
+        self._telemetry_reporter.report_once(swallow=True)
 
 
 class NodeCheckElasticAgent:

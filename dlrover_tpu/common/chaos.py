@@ -7,7 +7,8 @@ recovery invariants only hold when failures are injected *systematically*.
 This module is the one place every fault comes from: named **fault
 sites** threaded through the control plane (``rpc.send``, ``rpc.recv``,
 ``ipc.request``, ``agent.spawn``, ``ckpt.write``, ``ckpt.manifest``,
-``ckpt.save``, ``rdzv.join``) consult a seeded schedule that can drop or
+``ckpt.save``, ``rdzv.join``, ``master.kill``) consult a seeded schedule
+that can drop or
 delay RPC frames, kill or hang a process at a chosen step, tear a
 checkpoint payload mid-shard, or bit-flip persisted bytes.
 
@@ -322,10 +323,14 @@ def install_from_env() -> ChaosRegistry | None:
 # named schedules (tools/chaos_run.py + docs)
 # -------------------------------------------------------------------------
 
+# ``desc`` is documentation for ``tools/chaos_run.py --list``;
+# ChaosRegistry only reads ``seed``/``rules`` and ignores it.
 NAMED_SCHEDULES: dict[str, dict] = {
     # kill the worker right after it finishes the step-5 shm save; the
     # agent restarts it and it must resume from step 5
     "worker-kill": {
+        "desc": "kill the worker after the step-5 shm save; the agent "
+        "restarts it and it must resume from step 5 bit-correct",
         "seed": 7,
         "rules": [
             {"site": "ckpt.save", "action": "kill", "step": 5},
@@ -336,6 +341,8 @@ NAMED_SCHEDULES: dict[str, dict] = {
     # Deterministic counting, not probability — the rendezvous window
     # is only a handful of calls and a replay must actually flap.
     "rdzv-flap": {
+        "desc": "drop a deterministic burst of rendezvous RPCs; the "
+        "unified RetryPolicy must ride it out and still form the world",
         "seed": 11,
         "rules": [
             {
@@ -350,6 +357,8 @@ NAMED_SCHEDULES: dict[str, dict] = {
     # tear the final persisted checkpoint mid-shard: restore must fall
     # back to the newest verified step instead of loading torn bytes
     "torn-ckpt": {
+        "desc": "tear the step-8 persisted checkpoint mid-shard; "
+        "restore must fall back to the newest verified step",
         "seed": 13,
         "rules": [
             {"site": "ckpt.write", "action": "tear", "step": 8},
@@ -357,9 +366,30 @@ NAMED_SCHEDULES: dict[str, dict] = {
     },
     # bit-flip the newest manifest: verification must reject the step
     "manifest-bitflip": {
+        "desc": "bit-flip the step-8 shard manifest; verification must "
+        "reject the step and restore the previous verified one",
         "seed": 17,
         "rules": [
             {"site": "ckpt.manifest", "action": "bitflip", "step": 8},
+        ],
+    },
+    # kill the MASTER mid-job (on the 7th dataset task request, before
+    # it dispatches); a supervisor restarts it with --restore-state and
+    # the job must finish with every shard accounted exactly once, no
+    # worker restart, and the outage in the ledger's restart bucket
+    "master-kill": {
+        "desc": "kill the master mid-job; restarted from its durable "
+        "state it must resume with every shard exactly once and no "
+        "worker restart",
+        "seed": 29,
+        "rules": [
+            {
+                "site": "master.kill",
+                "action": "kill",
+                "msg": ["TaskRequest"],
+                "after": 6,
+                "max": 1,
+            },
         ],
     },
 }

@@ -150,6 +150,11 @@ class NodeEnv:
 
     RELAUNCHED_POD = "RELAUNCHED_POD"
     DLROVER_MASTER_ADDR = "DLROVER_MASTER_ADDR"
+    # A file holding the master's current host:port (written atomically
+    # by ``master.main --addr-file``). Clients re-read it when a
+    # connection dies, so a master restarted on a NEW port after a
+    # failover is picked up without respawning workers.
+    DLROVER_MASTER_ADDR_FILE = "DLROVER_MASTER_ADDR_FILE"
     GRPC_ENABLE_FORK = "GRPC_ENABLE_FORK_SUPPORT"
     POD_NAME = "POD_NAME"
     MONITOR_ENABLED = "MONITOR_ENABLED"
@@ -215,6 +220,9 @@ class JobConstant:
     MONITOR_INTERVAL = 15
     PENDING_TIMEOUT = 900
     SECTION_LOOP_INTERVAL = 30
+    # how long an agent rides out an unreachable master (workers keep
+    # training) before logging the outage as lost and re-probing
+    MASTER_RIDE_THROUGH_DEFAULT = 300.0
 
 
 class GRPC:

@@ -120,6 +120,17 @@ class JoinRendezvousRequest(Message):
 
 
 @dataclass
+class VerifiedStepsReport(Message):
+    """Refresh one node's restorable-step set WITHOUT joining — the
+    agent's post-failover re-registration (a join would dissolve the
+    restored round and force a worker restart)."""
+
+    node_rank: int = 0
+    rdzv_name: str = ""
+    steps: list = field(default_factory=list)
+
+
+@dataclass
 class RendezvousState(Message):
     round: int = 0
     waiting_num: int = 0

@@ -131,12 +131,23 @@ class RpcClient:
         addr: str,
         timeout: float = 30.0,
         policy: RetryPolicy | None = None,
+        addr_resolver=None,
     ):
         self._addr = addr
         self._timeout = timeout
         self._policy = policy
+        # callable -> current master address (or None/"" to keep the
+        # cached one). Consulted on every RE-connect, never on the hot
+        # path: a master restarted on a new port after a failover is
+        # picked up the moment the old socket dies, instead of the
+        # client hammering a dead endpoint forever.
+        self._resolver = addr_resolver
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
+
+    @property
+    def addr(self) -> str:
+        return self._addr
 
     @property
     def policy(self) -> RetryPolicy:
@@ -145,6 +156,17 @@ class RpcClient:
         return self._policy or default_rpc_policy()
 
     def _connect(self, timeout: float | None = None):
+        if self._resolver is not None:
+            try:
+                fresh = self._resolver()
+            except Exception:  # noqa: BLE001 - a broken resolver must
+                # not be worse than no resolver
+                fresh = None
+            if fresh and fresh != self._addr:
+                logger.info(
+                    "master address changed: %s -> %s", self._addr, fresh
+                )
+                self._addr = fresh
         host, _, port = self._addr.rpartition(":")
         sock = socket.create_connection(
             (host or "127.0.0.1", int(port)),

@@ -339,6 +339,12 @@ EVENT_CATEGORY = {
     # restart attribution, so the transfer leg stays visible
     "ckpt.restore.h2d": "checkpoint",
     "rdzv.wait": "rendezvous",
+    # the agent's master-outage ride-through: emitted with the outage
+    # duration once the (restarted) master answers again. Charged to
+    # ``restart`` — anything workers productively overlapped still wins
+    # by sweep priority, so only the genuinely stalled span is billed.
+    "master.restart": "restart",
+    "master.lost": "restart",
 }
 
 # overlap resolution, highest first (a checkpoint pause inside a step

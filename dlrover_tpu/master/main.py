@@ -7,6 +7,7 @@ which picks LocalJobMaster vs DistributedJobMaster by platform.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from dlrover_tpu.common.constants import PlatformType
@@ -36,6 +37,22 @@ def parse_args(argv=None):
     parser.add_argument(
         "--relaunch_on_worker_failure", type=int, default=3
     )
+    parser.add_argument(
+        "--state-dir", type=str, default="",
+        help="persist control-plane state (rendezvous, shard progress, "
+        "kv-store, barriers) here so a restarted master can resume",
+    )
+    parser.add_argument(
+        "--restore-state", type=str, default="", metavar="DIR",
+        help="restore control-plane state from DIR (implies "
+        "--state-dir DIR); with --port 0 the previous port is re-bound "
+        "so agents and workers reconnect without re-resolution",
+    )
+    parser.add_argument(
+        "--addr-file", type=str, default="",
+        help="write the bound host:port here (atomically); agents "
+        "re-read it via DLROVER_MASTER_ADDR_FILE when reconnecting",
+    )
     return parser.parse_args(argv)
 
 
@@ -47,8 +64,6 @@ def run(args) -> int:
     if telemetry.active_registry() is not None:
         # label this process's snapshots as the master (the registry
         # was created at import, before we knew the role)
-        import os
-
         os.environ.setdefault(telemetry.ENV_ROLE, "master")
         telemetry.enable()
     def _terminate(signum, frame):  # noqa: ARG001
@@ -69,8 +84,19 @@ def run(args) -> int:
         node_num=args.node_num,
         relaunch_on_worker_failure=args.relaunch_on_worker_failure,
     )
+    state_dir = args.restore_state or args.state_dir
+    restore = bool(args.restore_state)
+    port = args.port
+    if restore and port == 0:
+        # re-bind the previous incarnation's port so every cached
+        # worker/agent connection target stays valid across the failover
+        from dlrover_tpu.master.state_store import MasterStateStore
+
+        port = MasterStateStore.peek_port(state_dir)
     if args.platform == PlatformType.LOCAL:
-        master = LocalJobMaster(args.port, job_args)
+        master = LocalJobMaster(
+            port, job_args, state_dir=state_dir, restore_state=restore
+        )
     else:
         scaler = watcher = None
         if args.platform == PlatformType.KUBERNETES:
@@ -80,11 +106,18 @@ def run(args) -> int:
 
             scaler, watcher = new_pod_scaler_and_watcher(job_args)
         master = DistributedJobMaster(
-            args.port, job_args, scaler=scaler, watcher=watcher
+            port, job_args, scaler=scaler, watcher=watcher,
+            state_dir=state_dir, restore_state=restore,
         )
     master.prepare()
+    addr = f"127.0.0.1:{master.port}"
+    if args.addr_file:
+        tmp = f"{args.addr_file}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(addr)
+        os.replace(tmp, args.addr_file)
     # Print the bound address so a parent (tpu-run) can discover the port.
-    print(f"DLROVER_MASTER_ADDR=127.0.0.1:{master.port}", flush=True)
+    print(f"DLROVER_MASTER_ADDR={addr}", flush=True)
     return master.run()
 
 

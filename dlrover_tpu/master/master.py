@@ -47,12 +47,43 @@ class JobMaster:
         raise NotImplementedError
 
 
+def _setup_state_store(master, state_dir, restore_state):
+    """Bind a MasterStateStore to a constructed master's components and
+    (optionally) restore the previous incarnation's control-plane
+    state. Returns ``(store | None, restored)``."""
+    if not state_dir:
+        return None, False
+    from dlrover_tpu.master.state_store import MasterStateStore
+
+    store = MasterStateStore(state_dir)
+    store.bind(
+        task_manager=master.task_manager,
+        rdzv_managers=master.rdzv_managers,
+        kv_store=master.kv_store,
+        sync_service=master.sync_service,
+        servicer=master.servicer,
+        port=master.port,
+    )
+    restored = False
+    if restore_state:
+        restored = store.restore()
+    else:
+        # a NEW job on a reused state dir must not inherit the previous
+        # job's shard progress
+        store.reset()
+    master.servicer.state_store = store
+    return store, restored
+
+
 class LocalJobMaster(JobMaster):
     """Single-host master: task manager + rendezvous + kv-store served over
     the local control-plane port. Used by ``tpu-run`` when no cluster
     master exists (reference _launch_dlrover_local_master path)."""
 
-    def __init__(self, port: int, job_args=None):
+    def __init__(
+        self, port: int, job_args=None,
+        state_dir: str | None = None, restore_state: bool = False,
+    ):
         self._job_args = job_args
         self.task_manager = TaskManager()
         self.job_manager = LocalJobManager(
@@ -76,6 +107,9 @@ class LocalJobMaster(JobMaster):
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
         )
+        self.state_store, self._restored = _setup_state_store(
+            self, state_dir, restore_state
+        )
         self.paral_generator = ParalConfigGenerator(
             self.job_manager,
             self.task_manager.speed_monitor,
@@ -92,17 +126,22 @@ class LocalJobMaster(JobMaster):
 
     def prepare(self):
         node_num = getattr(self._job_args, "node_num", 1) or 1
-        for mgr in self.rdzv_managers.values():
-            mgr.update_rdzv_params(
-                min_nodes=node_num,
-                max_nodes=node_num,
-                waiting_timeout=JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT,
-                node_unit=1,
-            )
+        if not self._restored:
+            # a restored master keeps its persisted rendezvous params
+            # (elastic jobs may have reported non-default ones)
+            for mgr in self.rdzv_managers.values():
+                mgr.update_rdzv_params(
+                    min_nodes=node_num,
+                    max_nodes=node_num,
+                    waiting_timeout=JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT,
+                    node_unit=1,
+                )
         self.task_manager.start()
         self.job_manager.start()
         if getattr(self._job_args, "auto_tunning", False):
             self.paral_generator.start()
+        if self.state_store is not None:
+            self.state_store.start()
         self._server.start()
         logger.info("LocalJobMaster serving on %s", self.addr)
 
@@ -147,6 +186,8 @@ class LocalJobMaster(JobMaster):
         self.paral_generator.stop()
         self.task_manager.stop()
         self.job_manager.stop()
+        if self.state_store is not None:
+            self.state_store.stop()
         self._server.stop()
         from dlrover_tpu.common import telemetry
 
@@ -158,7 +199,10 @@ class DistributedJobMaster(JobMaster):
     (node monitoring/relaunch via a platform scaler+watcher), rendezvous,
     sharding, metrics; runs the 30s supervision loop."""
 
-    def __init__(self, port: int, job_args, scaler=None, watcher=None):
+    def __init__(
+        self, port: int, job_args, scaler=None, watcher=None,
+        state_dir: str | None = None, restore_state: bool = False,
+    ):
         self._job_args = job_args
         self.task_manager = TaskManager()
         self.job_manager = DistributedJobManager(
@@ -188,6 +232,9 @@ class DistributedJobMaster(JobMaster):
             sync_service=self.sync_service,
             elastic_ps_service=self.elastic_ps_service,
             job_metric_collector=self.metric_collector,
+        )
+        self.state_store, self._restored = _setup_state_store(
+            self, state_dir, restore_state
         )
         # Dead nodes must leave rendezvous waiting sets and give their
         # in-flight shards back (code-review finding: these existed but
@@ -246,13 +293,16 @@ class DistributedJobMaster(JobMaster):
 
     def prepare(self):
         node_num = getattr(self._job_args, "node_num", 1) or 1
-        for mgr in self.rdzv_managers.values():
-            mgr.update_rdzv_params(
-                min_nodes=node_num,
-                max_nodes=node_num,
-                waiting_timeout=JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT,
-                node_unit=1,
-            )
+        if not self._restored:
+            for mgr in self.rdzv_managers.values():
+                mgr.update_rdzv_params(
+                    min_nodes=node_num,
+                    max_nodes=node_num,
+                    waiting_timeout=JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT,
+                    node_unit=1,
+                )
+        if self.state_store is not None:
+            self.state_store.start()
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
@@ -351,6 +401,8 @@ class DistributedJobMaster(JobMaster):
         self.auto_scaler.stop_auto_scaling()
         self.task_manager.stop()
         self.job_manager.stop()
+        if self.state_store is not None:
+            self.state_store.stop()
         self._server.stop()
         from dlrover_tpu.common import telemetry
 

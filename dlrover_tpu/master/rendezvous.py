@@ -226,6 +226,85 @@ class RendezvousManager:
         with self._lock:
             self._waiting_nodes.clear()
 
+    def update_verified_steps(self, node_rank: int, steps) -> None:
+        """Refresh one node's locally-restorable step set WITHOUT
+        joining (a join would dissolve the formed round). Used by
+        agents re-registering after a master failover: the restored
+        master's persisted view may predate checkpoints persisted
+        during the outage."""
+        with self._lock:
+            self._verified_steps[node_rank] = frozenset(
+                int(s) for s in (steps or ()) if int(s) >= 0
+            )
+
+    # -------------------------------------------------- failover durability
+
+    def export_state(self) -> dict:
+        """JSON-serializable rendezvous state for the master state
+        store. Covers the base-class fields every manager shares; the
+        network-check manager's per-round probe results are transient
+        (a probe re-runs after failover) and intentionally excluded."""
+        with self._lock:
+            p = self._params
+            return {
+                "params": [
+                    p.min_nodes, p.max_nodes, p.waiting_timeout,
+                    p.node_unit,
+                ],
+                "round": self._rdzv_round,
+                "waiting": {
+                    str(r): list(v)
+                    for r, v in self._waiting_nodes.items()
+                },
+                "rdzv_nodes": {
+                    str(r): list(v) for r, v in self._rdzv_nodes.items()
+                },
+                "latest": list(self._latest_rdzv_nodes),
+                "verified_steps": {
+                    str(r): sorted(s)
+                    for r, s in self._verified_steps.items()
+                },
+                "restore_step": self._restore_step,
+                "first_join_time": self._first_join_time,
+                "coordinator_port": self._coordinator_port,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        with self._lock:
+            p = state.get("params")
+            if p:
+                self._params = RendezvousParameters(*p)
+            self._rdzv_round = int(state.get("round", 0))
+            self._waiting_nodes = {
+                int(r): tuple(v)
+                for r, v in (state.get("waiting") or {}).items()
+            }
+            self._rdzv_nodes = {
+                int(r): tuple(v)
+                for r, v in (state.get("rdzv_nodes") or {}).items()
+            }
+            self._latest_rdzv_nodes = [
+                int(r) for r in state.get("latest", [])
+            ]
+            self._verified_steps = {
+                int(r): frozenset(int(s) for s in steps)
+                for r, steps in (
+                    state.get("verified_steps") or {}
+                ).items()
+            }
+            self._restore_step = int(state.get("restore_step", -1))
+            self._first_join_time = float(
+                state.get("first_join_time", 0.0)
+            )
+            self._coordinator_port = int(
+                state.get("coordinator_port", 0)
+            )
+        logger.info(
+            "%s: restored round %d with members %s (waiting %s)",
+            self.name, self._rdzv_round,
+            sorted(self._rdzv_nodes), sorted(self._waiting_nodes),
+        )
+
 
 class ElasticTrainingRendezvousManager(RendezvousManager):
     name = RendezvousName.ELASTIC_TRAINING

@@ -12,6 +12,7 @@ import threading
 import time
 
 from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import RpcServer, RpcService
@@ -91,6 +92,38 @@ class CheckpointBarrierService:
             self._evict(self._persisted)
             return len(members) >= world
 
+    # -------------------------------------------------- failover durability
+
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "ready": [
+                    [g, s, sorted(m)]
+                    for (g, s), m in self._ready.items()
+                ],
+                "aborted": [
+                    [g, s, sorted(m)]
+                    for (g, s), m in self._aborted.items()
+                ],
+                "persisted": [
+                    [s, sorted(m)] for s, m in self._persisted.items()
+                ],
+            }
+
+    def restore_state(self, state: dict):
+        with self._lock:
+            self._ready = {
+                (g, int(s)): set(m)
+                for g, s, m in state.get("ready", [])
+            }
+            self._aborted = {
+                (g, int(s)): set(m)
+                for g, s, m in state.get("aborted", [])
+            }
+            self._persisted = {
+                int(s): set(m) for s, m in state.get("persisted", [])
+            }
+
 
 class MasterServicer(RpcService):
     def __init__(
@@ -114,14 +147,42 @@ class MasterServicer(RpcService):
         # job-wide telemetry merge: agents ship registry snapshots, the
         # report query serves the goodput ledger + merged timeline
         self.telemetry = JobTelemetry()
+        # durable control-plane state (master failover); set by the
+        # owning JobMaster when a state dir is configured
+        self.state_store = None
         self._start_training_time = 0.0
         self._job_ended = threading.Event()
         self._job_success = True
         self._run_configs: dict = {}
 
+    # ------------------------------------------------- state-store plumbing
+
+    def _mark_dirty(self):
+        store = self.state_store
+        if store is not None:
+            store.mark_dirty()
+
+    def _wal(self, op: str, **fields):
+        store = self.state_store
+        if store is not None:
+            store.wal_append(op, **fields)
+
+    @property
+    def _wal_hook(self):
+        """The raw append for callees that must log under their OWN
+        lock (kv-store write ordering); None when durability is off."""
+        store = self.state_store
+        return None if store is None else store.wal_append
+
     # ------------------------------------------------------------------ get
 
     def get(self, node_type: str, node_id: int, message):
+        # master-side kill/hang site: the server half of coordinator
+        # loss (agents' ride-through and the state store's restore are
+        # what a schedule here exercises)
+        chaos_point(
+            "master.kill", verb="get", msg=type(message).__name__
+        )
         if isinstance(message, msg.PsVersionRequest):
             if self.elastic_ps_service is None:
                 return msg.PsVersionResponse()
@@ -165,7 +226,11 @@ class MasterServicer(RpcService):
             value = self.kv_store.get(message.key)
             return msg.KeyValuePair(key=message.key, value=value)
         if isinstance(message, msg.KeyValueAddRequest):
-            value = self.kv_store.add(message.key, message.delta)
+            # the WAL hook runs under the kv lock so racing writes log
+            # in apply order; the record carries the RESULT (idempotent)
+            value = self.kv_store.add(
+                message.key, message.delta, wal=self._wal_hook
+            )
             return msg.KeyValueAddResult(value=value)
         if isinstance(message, msg.HeartBeat):
             action = self.job_manager.update_node_heartbeat(
@@ -203,8 +268,12 @@ class MasterServicer(RpcService):
     # --------------------------------------------------------------- report
 
     def report(self, node_type: str, node_id: int, message) -> bool:
+        chaos_point(
+            "master.kill", verb="report", msg=type(message).__name__
+        )
         if isinstance(message, msg.ElasticRunConfig):
             self.set_run_configs(message.configs)
+            self._mark_dirty()
             return True
         if isinstance(message, msg.RdzvParamsReport):
             for mgr in self.rdzv_managers.values():
@@ -219,11 +288,24 @@ class MasterServicer(RpcService):
                 "unit=%d", message.min_nodes, message.max_nodes,
                 message.waiting_timeout, message.node_unit,
             )
+            self._mark_dirty()
             return True
         if isinstance(message, msg.StreamingFeed):
-            return self.task_manager.feed_streaming_dataset(
+            ok = self.task_manager.feed_streaming_dataset(
                 message.dataset_name, message.count, message.end
             )
+            if ok:
+                ds = self.task_manager.get_dataset(message.dataset_name)
+                if ds is not None:
+                    # resulting totals, not the delta: replay moves the
+                    # high-water mark at most forward (idempotent)
+                    self._wal(
+                        "stream",
+                        ds=message.dataset_name,
+                        reported=ds._reported,
+                        ended=ds._ended,
+                    )
+            return ok
         if isinstance(message, msg.PsVersionReport):
             if self.elastic_ps_service is None:
                 return False
@@ -232,17 +314,23 @@ class MasterServicer(RpcService):
             )
             return True
         if isinstance(message, msg.DatasetShardParams):
-            self.task_manager.new_dataset(
-                batch_size=message.batch_size,
-                dataset_size=message.dataset_size,
-                dataset_name=message.dataset_name,
-                task_type=message.task_type,
-                num_epochs=message.num_epochs,
-                shuffle=message.shuffle,
-                num_minibatches_per_shard=message.num_minibatches_per_shard,
-                storage_type=message.storage_type,
-                dataset_type=message.dataset_type,
-            )
+            params = {
+                "batch_size": message.batch_size,
+                "dataset_size": message.dataset_size,
+                "dataset_name": message.dataset_name,
+                "task_type": message.task_type,
+                "num_epochs": message.num_epochs,
+                "shuffle": message.shuffle,
+                "num_minibatches_per_shard": (
+                    message.num_minibatches_per_shard
+                ),
+                "storage_type": message.storage_type,
+                "dataset_type": message.dataset_type,
+            }
+            self.task_manager.new_dataset(**params)
+            # durable BEFORE the ack: a crash right here must not leave
+            # acked dispatches against a dataset recovery can't rebuild
+            self._wal("dataset", params=params)
             if self.job_metric_collector is not None:
                 self.job_metric_collector.collect_dataset_metric(message)
             return True
@@ -264,6 +352,17 @@ class MasterServicer(RpcService):
                     message, "verified_ckpt_steps", None
                 ),
             )
+            self._mark_dirty()
+            return True
+        if isinstance(message, msg.VerifiedStepsReport):
+            # post-failover re-registration: refresh the node's
+            # restorable-step set WITHOUT a join (a join would dissolve
+            # the formed round and force a worker restart)
+            mgr = self.rdzv_managers.get(message.rdzv_name)
+            if mgr is None:
+                return False
+            mgr.update_verified_steps(message.node_rank, message.steps)
+            self._mark_dirty()
             return True
         if isinstance(message, msg.NodeCheckResultRequest):
             mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
@@ -297,28 +396,43 @@ class MasterServicer(RpcService):
             )
             return True
         if isinstance(message, msg.KeyValuePair):
-            self.kv_store.set(message.key, message.value)
+            self.kv_store.set(
+                message.key, message.value, wal=self._wal_hook
+            )
             return True
         if isinstance(message, msg.SyncJoin):
-            return self.sync_service.join_sync(
+            ok = self.sync_service.join_sync(
                 message.sync_name, node_type, node_id
             )
+            self._mark_dirty()
+            return ok
         if isinstance(message, msg.SyncFinish):
-            return self.sync_service.notify_barrier(message.sync_name)
+            ok = self.sync_service.notify_barrier(message.sync_name)
+            self._mark_dirty()
+            return ok
         if isinstance(message, msg.CheckpointReadyRequest):
-            return self.ckpt_barrier.report_ready(
+            ok = self.ckpt_barrier.report_ready(
                 message.group, message.step, message.node_id, message.world,
                 ready=message.ready,
             )
+            self._mark_dirty()
+            return ok
         if isinstance(message, msg.CheckpointSyncRequest):
             world = self._alive_worker_num()
-            return self.ckpt_barrier.sync_checkpoint(
+            ok = self.ckpt_barrier.sync_checkpoint(
                 message.step, message.node_id, max(world, 1)
             )
+            self._mark_dirty()
+            return ok
         if isinstance(message, msg.ShardCheckpoint):
-            return self.task_manager.restore_dataset_from_checkpoint(
+            ok = self.task_manager.restore_dataset_from_checkpoint(
                 message.content
             )
+            if ok:
+                # an acked worker-pushed restore must survive a crash:
+                # the content is absolute dataset state (idempotent)
+                self._wal("restore_ds", content=message.content)
+            return ok
         if isinstance(message, msg.DatasetTaskEnd):
             return True
         if isinstance(message, msg.NodeMeta):
@@ -331,7 +445,10 @@ class MasterServicer(RpcService):
             self._job_ended.set()
             return True
         if isinstance(message, msg.TelemetrySnapshot):
-            return self.telemetry.update(message.payload)
+            ok = self.telemetry.update(message.payload)
+            if ok:
+                self._mark_dirty()
+            return ok
         if isinstance(message, msg.DiagnosisReport):
             logger.info(
                 "diagnosis from %s-%s [%s]: %s",
@@ -358,6 +475,21 @@ class MasterServicer(RpcService):
         task = self.task_manager.get_dataset_task(
             node_type, node_id, request.dataset_name
         )
+        if task.task_id >= 0:
+            # durable dispatch record AFTER the mutation, BEFORE the
+            # ack: a restored master re-binds this task id to the same
+            # shard, so the worker's eventual completion report lands
+            # exactly once
+            self._wal(
+                "dispatch",
+                ds=request.dataset_name,
+                task_id=task.task_id,
+                start=task.shard.start,
+                end=task.shard.end,
+                indices=list(task.shard.record_indices),
+                node_type=node_type,
+                node_id=node_id,
+            )
         return msg.Task(
             task_id=task.task_id,
             task_type=task.task_type,
@@ -371,9 +503,17 @@ class MasterServicer(RpcService):
 
     def _report_task_result(self, result: msg.TaskResult) -> bool:
         success = not result.err_message
-        return self.task_manager.report_dataset_task(
+        ok = self.task_manager.report_dataset_task(
             result.dataset_name, result.task_id, success
         )
+        if ok or not success:
+            self._wal(
+                "task_result",
+                ds=result.dataset_name,
+                task_id=result.task_id,
+                success=success,
+            )
+        return ok
 
     def _get_comm_world(self, request: msg.CommWorldRequest):
         mgr = self.rdzv_managers.get(request.rdzv_name)
@@ -382,6 +522,10 @@ class MasterServicer(RpcService):
         rdzv_round, group, world, coordinator = mgr.get_comm_world(
             request.node_id
         )
+        if world:
+            # this poll may just have FORMED the round — the membership
+            # and consensus step must survive a master failover
+            self._mark_dirty()
         return msg.CommWorld(
             rdzv_name=request.rdzv_name,
             round=rdzv_round,

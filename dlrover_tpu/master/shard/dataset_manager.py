@@ -91,6 +91,17 @@ class BatchDatasetManager(DatasetManager):
     def report_task_status(self, task_id: int, success: bool):
         doing_task = self.doing.pop(task_id, None)
         if doing_task is None:
+            # master-failover path: a restore re-queued the worker's
+            # in-flight task into todo under its ORIGINAL id, and the
+            # (still-alive) worker just finished it — accept the
+            # completion instead of handing the shard out a second time
+            for i, task in enumerate(self.todo):
+                if task.task_id == task_id:
+                    doing_task = DoingTask(
+                        self.todo.pop(i), "", -1, time.time()
+                    )
+                    break
+        if doing_task is None:
             logger.warning("unknown or timed-out task %s reported", task_id)
             return False, None
         if not success:
@@ -161,12 +172,17 @@ class BatchDatasetManager(DatasetManager):
     # -- mid-job shard checkpoint (reference get/restore shard ckpt) -------
 
     def checkpoint(self) -> str:
+        # the 4th element (task id) lets a failover restore preserve the
+        # ids live workers still hold; pre-id checkpoints (3 elements)
+        # restore fine with fresh ids
         todo_ranges = [
-            [t.shard.start, t.shard.end, t.shard.record_indices]
+            [t.shard.start, t.shard.end, t.shard.record_indices,
+             t.task_id]
             for t in self.todo
         ]
         doing_ranges = [
-            [d.task.shard.start, d.task.shard.end, d.task.shard.record_indices]
+            [d.task.shard.start, d.task.shard.end,
+             d.task.shard.record_indices, d.task.task_id]
             for d in self.doing.values()
         ]
         return json.dumps(
@@ -176,6 +192,11 @@ class BatchDatasetManager(DatasetManager):
                 "epoch": self._splitter.get_epoch(),
                 "completed_step": self._completed_step,
                 "dataset_name": self._splitter.dataset_name,
+                # ids a worker still holds across a master failover must
+                # never collide with freshly assigned ones — a stale
+                # completion report acking a DIFFERENT shard would break
+                # exactly-once accounting
+                "next_task_id": self._task_id,
             }
         )
 
@@ -185,20 +206,102 @@ class BatchDatasetManager(DatasetManager):
         self.doing.clear()
         self._splitter.epoch = state.get("epoch", 0)
         self._completed_step = state.get("completed_step", 0)
-        shards = []
-        # doing tasks were in flight at ckpt time -> back to todo first.
-        for start, end, indices in state.get("doing", []) + state.get(
-            "todo", []
-        ):
-            shards.append(
+        self._task_id = max(
+            self._task_id, int(state.get("next_task_id", 0))
+        )
+        # doing tasks were in flight at ckpt time -> back to todo first,
+        # KEEPING their original ids where the checkpoint recorded them:
+        # a live worker finishing one across a master failover reports
+        # that id, and report_task_status completes it out of todo
+        for entry in state.get("doing", []) + state.get("todo", []):
+            start, end, indices = entry[0], entry[1], entry[2]
+            task_id = entry[3] if len(entry) > 3 else None
+            if task_id is None:
+                task_id = self._task_id
+                self._task_id += 1
+            else:
+                self._task_id = max(self._task_id, task_id + 1)
+            self.todo.append(Task(
+                task_id,
+                self._task_type,
                 Shard(
                     name=state.get("dataset_name", ""),
                     start=start,
                     end=end,
                     record_indices=indices,
-                )
+                ),
+            ))
+
+    # -- WAL replay (master failover) --------------------------------------
+    #
+    # Replay records carry absolute state (task id + shard range), so
+    # every method is idempotent: the state store may re-apply records
+    # already reflected in the snapshot it restored.
+
+    def replay_dispatch(
+        self, task_id: int, start: int, end: int, indices,
+        node_type: str = "", node_id: int = -1,
+        allow_create: bool = False,
+    ):
+        """A task the previous master incarnation handed out: move the
+        matching todo shard back into doing under its original id.
+
+        Matched by id (an id-preserving restore) — but only when the
+        range agrees, since WAL-only recovery of a shuffled dataset
+        re-draws shard order and the id alone could bind a range the
+        worker does not hold — else by range. ``allow_create`` is set
+        ONLY for WAL-only recovery (no snapshot applied): with a
+        snapshot, that state is authoritative and a dispatch that finds
+        nothing was already covered by it — materializing a new epoch
+        here would falsely complete a shard that was never trained."""
+        if task_id in self.doing:
+            self._task_id = max(self._task_id, task_id + 1)
+            return
+        if (
+            allow_create
+            and not self.todo
+            and not self._splitter.epoch_finished()
+        ):
+            # crash before the first snapshot: materialize the epoch's
+            # shards like get_task would, so the logged dispatches have
+            # something to re-bind to
+            self._splitter.create_shards()
+            self._create_tasks(self._splitter.get_shards())
+        self._task_id = max(self._task_id, task_id + 1)
+        idx = next(
+            (i for i, t in enumerate(self.todo)
+             if t.task_id == task_id
+             and t.shard.start == start and t.shard.end == end),
+            None,
+        )
+        if idx is None:
+            idx = next(
+                (i for i, t in enumerate(self.todo)
+                 if t.shard.start == start and t.shard.end == end),
+                None,
             )
-        self._create_tasks(shards)
+        if idx is None:
+            # neither todo nor doing: the snapshot already covered the
+            # completion (or the range predates it) — nothing to do
+            return
+        task = self.todo.pop(idx)
+        task.task_id = task_id
+        if indices:
+            # the worker is processing the indices the ORIGINAL
+            # dispatch carried; a re-shuffled re-creation may have
+            # drawn different ones into this range
+            task.shard.record_indices = list(indices)
+        self.doing[task_id] = DoingTask(
+            task, node_type, node_id, time.time()
+        )
+
+    def replay_result(self, task_id: int, success: bool):
+        known = task_id in self.doing or any(
+            t.task_id == task_id for t in self.todo
+        )
+        if known:
+            self.report_task_status(task_id, success)
+        # unknown id: the snapshot already covered this completion
 
 
 class StreamingDatasetManager(BatchDatasetManager):
@@ -285,10 +388,14 @@ class StreamingDatasetManager(BatchDatasetManager):
             "reported": self._reported,
             "ended": self._ended,
             "completed_step": self._completed_step,
+            "next_task_id": self._task_id,
             "todo": [
-                [t.task.shard.start, t.task.shard.end]
+                [t.task.shard.start, t.task.shard.end, t.task.task_id]
                 for t in self.doing.values()
-            ] + [[t.shard.start, t.shard.end] for t in self.todo],
+            ] + [
+                [t.shard.start, t.shard.end, t.task_id]
+                for t in self.todo
+            ],
         })
 
     def restore_checkpoint(self, content: str):
@@ -299,13 +406,34 @@ class StreamingDatasetManager(BatchDatasetManager):
         self._reported = int(data["reported"])
         self._ended = bool(data["ended"])
         self._completed_step = int(data.get("completed_step", 0))
+        self._task_id = max(
+            self._task_id, int(data.get("next_task_id", 0))
+        )
         self.todo.clear()
         self.doing.clear()
-        shards = [
-            Shard(name=self.dataset_name, start=a, end=b)
-            for a, b in data.get("todo", [])
-        ]
-        self._create_tasks(shards)
+        for entry in data.get("todo", []):
+            start, end = entry[0], entry[1]
+            task_id = entry[2] if len(entry) > 2 else None
+            if task_id is None:
+                task_id = self._task_id
+                self._task_id += 1
+            else:
+                self._task_id = max(self._task_id, task_id + 1)
+            self.todo.append(Task(
+                task_id,
+                self._task_type,
+                Shard(name=self.dataset_name, start=start, end=end),
+            ))
+
+    def replay_stream(self, reported: int, ended: bool):
+        """Idempotent replay of producer feeds: records carry resulting
+        totals, not deltas, so re-applying moves the high-water mark at
+        most forward."""
+        if reported > self._reported:
+            self._reported = int(reported)
+            self._cut_shards()
+        if ended and not self._ended:
+            self.end_stream()
 
 
 class _NullSplitter:

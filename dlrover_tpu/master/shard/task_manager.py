@@ -26,6 +26,9 @@ class TaskManager:
     def __init__(self, worker_restart_timeout: float = 0.0):
         self._lock = threading.Lock()
         self._datasets: dict[str, BatchDatasetManager] = {}
+        # creation kwargs per dataset, so a restored master can rebuild
+        # each manager before applying its shard-progress checkpoint
+        self._dataset_params: dict[str, dict] = {}
         self._worker_restart_timeout = worker_restart_timeout
         self._speed_monitor = SpeedMonitor()
         self._task_timeout_callbacks: list = []
@@ -52,6 +55,20 @@ class TaskManager:
             if dataset_name in self._datasets:
                 logger.info("dataset %s already registered", dataset_name)
                 return
+            if dataset_splitter is None:
+                self._dataset_params[dataset_name] = {
+                    "batch_size": batch_size,
+                    "dataset_size": dataset_size,
+                    "dataset_name": dataset_name,
+                    "task_type": task_type,
+                    "num_epochs": num_epochs,
+                    "shuffle": shuffle,
+                    "num_minibatches_per_shard": (
+                        num_minibatches_per_shard
+                    ),
+                    "storage_type": storage_type,
+                    "dataset_type": dataset_type,
+                }
             if dataset_type == "streaming":
                 self._datasets[dataset_name] = StreamingDatasetManager(
                     task_type,
@@ -163,6 +180,68 @@ class TaskManager:
         except Exception as e:  # noqa: BLE001
             logger.warning("restore dataset ckpt failed: %s", e)
             return False
+
+    # -- failover durability (master state store) --------------------------
+
+    def export_state(self) -> dict:
+        """Per-dataset creation params + shard-progress checkpoint.
+        Datasets registered with a caller-provided splitter (tests,
+        embedded use) carry no params and are skipped — they cannot be
+        rebuilt from persisted state."""
+        with self._lock:
+            out = {}
+            for name, ds in self._datasets.items():
+                params = self._dataset_params.get(name)
+                if params is None:
+                    logger.warning(
+                        "dataset %s has a custom splitter; not "
+                        "persisted for failover", name,
+                    )
+                    continue
+                out[name] = {
+                    "params": dict(params),
+                    "state": ds.checkpoint(),
+                }
+            return out
+
+    def restore_state(self, datasets: dict):
+        for name, entry in datasets.items():
+            self.new_dataset(**entry["params"])
+            with self._lock:
+                ds = self._datasets.get(name)
+            if ds is not None and entry.get("state"):
+                ds.restore_checkpoint(entry["state"])
+                logger.info(
+                    "restored dataset %s: todo=%d completed_step=%d",
+                    name, len(ds.todo), ds.completed_step,
+                )
+
+    def replay_dispatch(
+        self, dataset_name: str, task_id: int, start: int, end: int,
+        indices, node_type: str = "", node_id: int = -1,
+        allow_create: bool = False,
+    ):
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is not None:
+                ds.replay_dispatch(
+                    task_id, start, end, indices, node_type, node_id,
+                    allow_create=allow_create,
+                )
+
+    def replay_result(self, dataset_name: str, task_id: int,
+                      success: bool):
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is not None:
+                ds.replay_result(task_id, success)
+
+    def replay_stream(self, dataset_name: str, reported: int,
+                      ended: bool):
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if isinstance(ds, StreamingDatasetManager):
+                ds.replay_stream(reported, ended)
 
     def start(self):
         t = threading.Thread(

@@ -2,6 +2,7 @@
 
 Usage:
     python tools/chaos_run.py --schedule worker-kill
+    python tools/chaos_run.py --schedule master-kill
     python tools/chaos_run.py --schedule @/path/to/schedule.json
     python tools/chaos_run.py --schedule '{"seed":7,"rules":[...]}'
     python tools/chaos_run.py --list
@@ -11,7 +12,14 @@ ElasticTrainingAgent whose worker trains a toy counter with flash
 checkpoints, with ``DLROVER_CHAOS`` armed from the requested schedule —
 the same harness tests/test_chaos_schedules.py asserts against, as a
 CLI for reproducing a fault pattern while debugging. Prints the job
-outcome, the worker's result record, and the chaos fire summary."""
+outcome, the worker's result record, and the chaos fire summary.
+
+Schedules containing a ``master.kill`` rule use a different harness:
+the master runs as a SUBPROCESS with ``--state-dir`` (so the kill
+actually severs the control plane), a supervisor restarts it with
+``--restore-state`` when it dies, and the worker consumes dataset
+shards through a ShardingClient — the post-run check asserts every
+shard was handed out exactly once across the failover."""
 
 from __future__ import annotations
 
@@ -19,8 +27,11 @@ import argparse
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
+import threading
+import time
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -68,58 +79,9 @@ engine.close()
 """
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument(
-        "--schedule",
-        help="named schedule, inline JSON, or @/path/to/schedule.json",
-    )
-    parser.add_argument(
-        "--list", action="store_true", help="list named schedules"
-    )
-    parser.add_argument("--steps", type=int, default=10)
-    parser.add_argument(
-        "--out-dir", default="", help="work dir (default: a temp dir)"
-    )
-    parser.add_argument(
-        "--keep", action="store_true",
-        help="keep the work dir (logs, checkpoints) for inspection",
-    )
-    args = parser.parse_args()
-
-    # env must be armed BEFORE dlrover_tpu imports anywhere (the chaos
-    # and telemetry modules read it once at import), and before jax
-    # picks a backend. This process hosts the agent AND the in-process
-    # local master; its telemetry source is labeled "agent".
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    os.environ.setdefault("DLROVER_TELEMETRY_ROLE", "agent")
-    from dlrover_tpu.common import chaos
-
-    if args.list or not args.schedule:
-        print("named schedules:")
-        for name, sched in chaos.NAMED_SCHEDULES.items():
-            print(f"  {name}: {json.dumps(sched)}")
-        return 0
-
-    schedule = chaos.resolve_schedule(args.schedule)
-    out_dir = args.out_dir or tempfile.mkdtemp(prefix="chaos_run_")
-    os.makedirs(out_dir, exist_ok=True)
-    os.environ["CHAOS_OUT_DIR"] = out_dir
-    os.environ["CHAOS_TOTAL_STEPS"] = str(args.steps)
-    os.environ["DLROVER_TPU_SOCKET_DIR"] = os.path.join(out_dir, "socks")
-    os.environ["ELASTIC_JOB_NAME"] = f"chaos_run_{os.getpid()}"
-    # telemetry: every process (this one + workers) leaves a snapshot so
-    # the post-run goodput ledger/timeline can be assembled
-    tele_dir = os.path.join(out_dir, "telemetry")
-    os.environ.setdefault("DLROVER_TELEMETRY_DIR", tele_dir)
-    # the worker subprocess arms itself from this env; this (agent)
-    # process stays clean so master/agent control flow is unperturbed
-    # unless the schedule targets agent/master sites — then arm locally
-    os.environ[chaos.ENV_VAR] = json.dumps(schedule)
-    agent_sites = {"rpc.send", "rpc.recv", "rdzv.join", "agent.spawn"}
-    if any(r.get("site") in agent_sites for r in schedule.get("rules", [])):
-        chaos.install(schedule)
-
+def _run_in_process(out_dir: str) -> int:
+    """The original harness: in-process LocalJobMaster + agent whose
+    worker trains a toy counter with flash checkpoints."""
     from dlrover_tpu.agent.master_client import MasterClient
     from dlrover_tpu.agent.training_agent import (
         ElasticLaunchConfig,
@@ -157,6 +119,238 @@ def main() -> int:
             print(f"worker result: {f.read()}")
     else:
         print("worker result: MISSING (job never completed)")
+    return rc
+
+
+SHARD_WORKER = """
+import json, os, time
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.sharding_client import ShardingClient
+from dlrover_tpu.common import telemetry
+
+out_dir = os.environ["CHAOS_OUT_DIR"]
+dataset_size = int(os.environ.get("CHAOS_DATASET_SIZE", "40"))
+client = MasterClient.singleton_instance()
+sc = ShardingClient(
+    "train", batch_size=2, num_epochs=1, dataset_size=dataset_size,
+    num_minibatches_per_shard=2, master_client=client,
+)
+done = []
+while True:
+    shard = sc.fetch_shard()
+    if shard is None:
+        break
+    t0 = time.time()
+    time.sleep(0.15)  # "train" on the shard
+    sc.report_batch_done()
+    done.append([shard.start, shard.end])
+    telemetry.event("step.end", step=len(done), dur=time.time() - t0)
+    telemetry.flush()
+with open(out_dir + "/result.json", "w") as f:
+    json.dump({"shards": done}, f)
+client.close()
+"""
+
+
+def _run_master_failover(schedule: dict, out_dir: str, steps: int) -> int:
+    """Kill-the-master harness: the master is a SUBPROCESS persisting
+    its control-plane state; a supervisor restarts it with
+    ``--restore-state`` when the armed schedule kills it. The worker
+    consumes dataset shards, and the post-run check asserts every shard
+    was handed out exactly once across the failover — plus that the
+    agent never restarted its worker."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.training_agent import (
+        ElasticLaunchConfig,
+        ElasticTrainingAgent,
+        WorkerSpec,
+    )
+    from dlrover_tpu.common.constants import NodeEnv, NodeType
+    from dlrover_tpu.common.rpc import addr_connectable, find_free_port
+
+    # the worker's shard fetches must ride the outage inside one retry
+    # budget; the agent's ride-through probes fast
+    os.environ.setdefault("DLROVER_RPC_MAX_ATTEMPTS", "30")
+    os.environ.setdefault("DLROVER_MASTER_RIDE_POLL", "0.2")
+
+    state_dir = os.path.join(out_dir, "master_state")
+    addr_file = os.path.join(out_dir, "master_addr")
+    master_log = os.path.join(out_dir, "master.log")
+    port = find_free_port()
+    addr = f"127.0.0.1:{port}"
+    dataset_size = steps * 4  # shard size 4 (batch 2 x 2 minibatches)
+    os.environ["CHAOS_DATASET_SIZE"] = str(dataset_size)
+    # workers/agents re-resolve the master from this file on reconnect
+    os.environ[NodeEnv.DLROVER_MASTER_ADDR_FILE] = addr_file
+
+    env = dict(os.environ)
+    env["DLROVER_TELEMETRY_ROLE"] = "master"
+
+    def spawn(restore: bool) -> subprocess.Popen:
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            "--port", str(port), "--node_num", "1",
+            "--addr-file", addr_file,
+        ]
+        spawn_env = dict(env)
+        if restore:
+            cmd += ["--restore-state", state_dir]
+            # one-shot coordinator loss: a fresh process would reset
+            # the rule counters and kill itself again
+            spawn_env.pop("DLROVER_CHAOS", None)
+        else:
+            cmd += ["--state-dir", state_dir]
+        with open(master_log, "ab") as log:
+            return subprocess.Popen(  # noqa: S603
+                cmd, env=spawn_env, stdout=log,
+                stderr=subprocess.STDOUT,
+            )
+
+    proc = spawn(False)
+    restarts: list[int] = []
+    done = threading.Event()
+
+    def supervise():
+        nonlocal proc
+        while not done.is_set():
+            rc = proc.poll()
+            if rc is not None and rc != 0 and not done.is_set():
+                print(
+                    f"master died rc={rc}; restarting with "
+                    f"--restore-state {state_dir}"
+                )
+                restarts.append(rc)
+                proc = spawn(True)
+            time.sleep(0.1)
+
+    deadline = time.time() + 30
+    while not addr_connectable(addr, timeout=0.5):
+        if proc.poll() not in (None, 0):
+            print(f"master failed to start; see {master_log}")
+            return 1
+        if time.time() > deadline:
+            print("master never became connectable")
+            proc.kill()
+            return 1
+        time.sleep(0.2)
+    threading.Thread(target=supervise, daemon=True).start()
+
+    script = os.path.join(out_dir, "shard_worker.py")
+    with open(script, "w") as f:
+        f.write(SHARD_WORKER)
+    config = ElasticLaunchConfig(
+        min_nodes=1, max_nodes=1, nproc_per_node=1,
+        monitor_interval=0.3, rdzv_timeout=60, max_restarts=3,
+        log_dir=out_dir, master_ride_through=60,
+    )
+    client = MasterClient(addr, 0, NodeType.WORKER)
+    agent = ElasticTrainingAgent(
+        config, WorkerSpec(script, (), config), client
+    )
+    try:
+        rc = agent.run()
+    finally:
+        done.set()
+        client.close()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+
+    print(
+        f"\nagent exit code: {rc}  worker restarts: "
+        f"{agent._restart_count}  master restarts: {len(restarts)}"
+    )
+    result_path = os.path.join(out_dir, "result.json")
+    if not os.path.exists(result_path):
+        print("worker result: MISSING (job never completed)")
+        return rc or 1
+    with open(result_path) as f:
+        covered = sorted(tuple(s) for s in json.load(f)["shards"])
+    expected = [
+        (i, min(i + 4, dataset_size))
+        for i in range(0, dataset_size, 4)
+    ]
+    dupes = len(covered) - len(set(covered))
+    missing = len(set(expected) - set(covered))
+    print(
+        f"shards handed out: {len(covered)} of {len(expected)} "
+        f"(duplicated={dupes}, missing={missing})"
+    )
+    if dupes or missing:
+        print("FAIL: shard accounting is not exactly-once")
+        return rc or 1
+    return rc
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--schedule",
+        help="named schedule, inline JSON, or @/path/to/schedule.json",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list named schedules"
+    )
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument(
+        "--out-dir", default="", help="work dir (default: a temp dir)"
+    )
+    parser.add_argument(
+        "--keep", action="store_true",
+        help="keep the work dir (logs, checkpoints) for inspection",
+    )
+    args = parser.parse_args()
+
+    # env must be armed BEFORE dlrover_tpu imports anywhere (the chaos
+    # and telemetry modules read it once at import), and before jax
+    # picks a backend. This process hosts the agent AND the in-process
+    # local master; its telemetry source is labeled "agent".
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DLROVER_TELEMETRY_ROLE", "agent")
+    from dlrover_tpu.common import chaos
+
+    if args.list or not args.schedule:
+        print("named schedules:")
+        width = max(len(n) for n in chaos.NAMED_SCHEDULES)
+        for name, sched in chaos.NAMED_SCHEDULES.items():
+            desc = sched.get("desc", "")
+            print(f"  {name:<{width}}  {desc}")
+        print(
+            "\nreplay one with --schedule <name>; full JSON via "
+            "python -c 'from dlrover_tpu.common import chaos; "
+            "print(chaos.NAMED_SCHEDULES[\"<name>\"])'"
+        )
+        return 0
+
+    schedule = chaos.resolve_schedule(args.schedule)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="chaos_run_")
+    os.makedirs(out_dir, exist_ok=True)
+    os.environ["CHAOS_OUT_DIR"] = out_dir
+    os.environ["CHAOS_TOTAL_STEPS"] = str(args.steps)
+    os.environ["DLROVER_TPU_SOCKET_DIR"] = os.path.join(out_dir, "socks")
+    os.environ["ELASTIC_JOB_NAME"] = f"chaos_run_{os.getpid()}"
+    # telemetry: every process (this one + workers) leaves a snapshot so
+    # the post-run goodput ledger/timeline can be assembled
+    tele_dir = os.path.join(out_dir, "telemetry")
+    os.environ.setdefault("DLROVER_TELEMETRY_DIR", tele_dir)
+    # the worker subprocess arms itself from this env; this (agent)
+    # process stays clean so master/agent control flow is unperturbed
+    # unless the schedule targets agent/master sites — then arm locally
+    os.environ[chaos.ENV_VAR] = json.dumps(schedule)
+    agent_sites = {"rpc.send", "rpc.recv", "rdzv.join", "agent.spawn"}
+    if any(r.get("site") in agent_sites for r in schedule.get("rules", [])):
+        chaos.install(schedule)
+
+    if any(
+        r.get("site") == "master.kill"
+        for r in schedule.get("rules", [])
+    ):
+        # coordinator-loss harness: subprocess master + supervisor
+        rc = _run_master_failover(schedule, out_dir, args.steps)
+    else:
+        rc = _run_in_process(out_dir)
+
     reg = chaos.active_registry()
     if reg is not None:
         print(f"agent-side chaos fires: {reg.summary()}")
