@@ -155,6 +155,86 @@ def _sparse_bench(on_tpu: bool) -> dict:
     }
 
 
+def _control_plane_bench(n_agents: int = 8, seconds: float = 1.5) -> dict:
+    """Master control-plane latency baseline: an in-process master with
+    N client threads driving the real agent call mix (rendezvous joins,
+    comm-world polls, step reports, kv traffic). Publishes the keys the
+    future 1000-agent swarm harness will regress against:
+    ``master_rpc_p99_ms`` (per-verb servicer latency, quantiles
+    interpolated from the le-bucket histograms the RPC server records)
+    and ``joins_per_sec`` (sustained join throughput)."""
+    import threading
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common import telemetry
+    from dlrover_tpu.common.constants import NodeType, RendezvousName
+    from dlrover_tpu.common.telemetry import (
+        hist_quantile,
+        sum_bucket_counts,
+    )
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.scheduler.job import new_job_args
+
+    master = LocalJobMaster(
+        0, new_job_args("local", "cp-bench", node_num=n_agents)
+    )
+    master.prepare()
+    deadline = time.monotonic() + seconds
+    joins = [0] * n_agents
+    errors = [0]
+
+    def agent_loop(rank: int):
+        client = MasterClient(master.addr, rank, NodeType.WORKER)
+        try:
+            while time.monotonic() < deadline:
+                client.join_rendezvous(
+                    rank, 1, RendezvousName.ELASTIC_TRAINING
+                )
+                joins[rank] += 1
+                client.get_comm_world(
+                    RendezvousName.ELASTIC_TRAINING, rank
+                )
+                client.report_heart_beat()
+                client.report_global_step(joins[rank])
+                client.kv_store_set(f"k{rank}", b"v")
+        except Exception:  # noqa: BLE001 - surfaced via error count
+            errors[0] += 1
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=agent_loop, args=(r,), daemon=True)
+        for r in range(n_agents)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=seconds + 30)
+    wall = time.perf_counter() - t0
+    master.stop()
+
+    snap = telemetry.snapshot() or {}
+    bounds, overall = sum_bucket_counts(
+        h for h in snap.get("histograms", ())
+        if h["name"] == "master.rpc.seconds"
+    )
+    if bounds is None:
+        return {"control_plane_error": "no master.rpc.seconds recorded"}
+    return {
+        "master_rpc_p50_ms": round(
+            hist_quantile(bounds, overall, 0.50) * 1e3, 4
+        ),
+        "master_rpc_p99_ms": round(
+            hist_quantile(bounds, overall, 0.99) * 1e3, 4
+        ),
+        "master_rpc_calls": sum(overall),
+        "joins_per_sec": round(sum(joins) / wall, 1),
+        "control_plane_agents": n_agents,
+        "control_plane_errors": errors[0],
+    }
+
+
 def main():
     import gc
     import dataclasses as _dc
@@ -616,6 +696,15 @@ def main():
     except Exception as e:  # noqa: BLE001 - best-effort micro-bench
         sparse = {"sparse_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
+    # control-plane latency surface (pure CPU/socket work, backend-
+    # independent): master_rpc_p99_ms / joins_per_sec baseline
+    try:
+        control_plane = _control_plane_bench()
+    except Exception as e:  # noqa: BLE001 - best-effort micro-bench
+        control_plane = {
+            "control_plane_error": f"{type(e).__name__}: {e}"[:120]
+        }
+
     from dlrover_tpu.common.arena import get_arena
 
     arena_stats = get_arena().stats()
@@ -710,6 +799,7 @@ def main():
             "remat_none_checkpoint_free": remat_none_checkpoint_free,
             "remat_none_checkpoint_detail": remat_none_checkpoint_detail,
             **sparse,
+            **control_plane,
             "backend": jax.default_backend(),
         },
     }))

@@ -246,6 +246,13 @@ class MasterClient:
     def check_straggler(self) -> msg.NetworkCheckResult:
         return self._get(msg.StragglerExistRequest())
 
+    def get_diagnosis(self) -> msg.DiagnosisResult:
+        """The master's current runtime verdicts (stragglers + hangs).
+        Best-effort fail-fast poll like the stats reports."""
+        res = self._get(msg.DiagnosisRequest(node_rank=self._node_id),
+                        retries=1)
+        return res if res is not None else msg.DiagnosisResult()
+
     def report_failure(
         self, error_data: str, level: str, restart_count: int = 0
     ) -> bool:

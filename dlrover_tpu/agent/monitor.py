@@ -340,12 +340,14 @@ class TimerRingExporter:
             return self._export_once_locked()
 
     def _export_once_locked(self) -> dict:
+        from dlrover_tpu.common import telemetry
         from dlrover_tpu.trainer.timer import Tag
 
         try:
             records = self._ensure_timer().drain()
         except Exception:  # noqa: BLE001 - ring not created yet
             return {}
+        recent: dict = {}
         for tag, _start, dur in records:
             agg = self._totals.setdefault(
                 tag, {"count": 0, "total_ns": 0, "max_ns": 0}
@@ -353,6 +355,9 @@ class TimerRingExporter:
             agg["count"] += 1
             agg["total_ns"] += dur
             agg["max_ns"] = max(agg["max_ns"], dur)
+            r = recent.setdefault(tag, {"count": 0, "total_ns": 0})
+            r["count"] += 1
+            r["total_ns"] += dur
         stats = {
             Tag.NAMES.get(tag, str(tag)): {
                 "count": a["count"],
@@ -361,6 +366,30 @@ class TimerRingExporter:
             }
             for tag, a in self._totals.items()
         }
+        # publish the aggregates into this agent's telemetry registry:
+        # the TelemetryReporter relays them to the master, where
+        # master/diagnosis.py z-scores them ACROSS hosts — the
+        # out-of-process half of the xpu_timer capability becomes a
+        # fleet-wide straggler signal, not just a local JSON file.
+        # recent_avg = the window drained THIS tick, so a host that
+        # becomes slow shows up immediately instead of diluting into
+        # its lifetime average.
+        for name, agg in stats.items():
+            telemetry.gauge_set(
+                "timer.phase.avg_ms", agg["avg_ms"], phase=name
+            )
+            telemetry.gauge_set(
+                "timer.phase.max_ms", agg["max_ms"], phase=name
+            )
+            telemetry.gauge_set(
+                "timer.phase.count", agg["count"], phase=name
+            )
+        for tag, r in recent.items():
+            telemetry.gauge_set(
+                "timer.phase.recent_avg_ms",
+                round(r["total_ns"] / r["count"] / 1e6, 3),
+                phase=Tag.NAMES.get(tag, str(tag)),
+            )
         if records:
             os.makedirs(os.path.dirname(self._out_path), exist_ok=True)
             tmp = f"{self._out_path}.tmp.{os.getpid()}"

@@ -13,6 +13,7 @@ import threading
 import time
 
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import tracing
 from dlrover_tpu.common.constants import TaskType
 from dlrover_tpu.common.log import get_logger
 
@@ -63,12 +64,16 @@ class ShardingClient:
         Streaming datasets return WAIT tasks while momentarily dry; the
         client blocks (polling) until data arrives or the stream ends.
         """
-        while True:
-            task = self._client.get_task(self.dataset_name)
-            if task is not None and task.task_type == TaskType.WAIT:
-                time.sleep(wait_interval)
-                continue
-            break
+        # fetch span roots the shard's trace: the master-side dispatch
+        # span nests under it, and report_batch_done joins the same
+        # trace via the task_id label
+        with tracing.span("shard.fetch", dataset=self.dataset_name):
+            while True:
+                task = self._client.get_task(self.dataset_name)
+                if task is not None and task.task_type == TaskType.WAIT:
+                    time.sleep(wait_interval)
+                    continue
+                break
         if task is None or task.task_id < 0:
             return None
         with self._lock:
@@ -95,7 +100,10 @@ class ShardingClient:
                     if t.task_id not in task_ids
                 ]
         for t in tasks:
-            self._client.report_task_result(self.dataset_name, t.task_id)
+            with tracing.span("shard.report", task_id=t.task_id):
+                self._client.report_task_result(
+                    self.dataset_name, t.task_id
+                )
 
     def report_all_pending_done(self):
         """Ack every pending shard task (end-of-epoch drain)."""

@@ -25,7 +25,7 @@ import sys
 import time
 
 from dlrover_tpu.agent.master_client import MasterClient
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import flight, telemetry, tracing
 from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.agent.monitor import (
     HeartbeatReporter,
@@ -168,6 +168,15 @@ class MasterRendezvousHandler:
 
     def next_rendezvous(self):
         """Returns (round, world, rank_offset, total_world, coordinator)."""
+        # root span of the round's trace: every join/poll RPC under it
+        # propagates this context, so the master-side join/form spans
+        # nest under it — one cross-host tree per rendezvous round
+        with tracing.span(
+            "rdzv.round", rank=self._node_rank, rdzv=self._name
+        ):
+            return self._next_rendezvous()
+
+    def _next_rendezvous(self):
         t0 = time.monotonic()
         verified_steps = self._local_verified_steps()
         newest = verified_steps[0] if verified_steps else -1
@@ -310,6 +319,10 @@ class ElasticTrainingAgent:
         # set while the agent itself is terminating workers, so their
         # -SIGTERM exits classify as "stopped" instead of "software"
         self._stopping = False
+        # True while the current contiguous hang-diagnosis episode has
+        # already been flight-dumped (one artifact per episode, not one
+        # per monitor tick); cleared when the verdict clears
+        self._hang_episode_dumped = False
 
     # ----------------------------------------------------------- lifecycle
 
@@ -543,6 +556,9 @@ class ElasticTrainingAgent:
             AsyncCheckpointSaver.register_signal_handlers()
         except ValueError:
             pass  # not the main thread (tests)
+        # a preempted/SIGTERMed agent leaves its flight record (last
+        # spans/events + thread stacks) before dying
+        flight.install()
         self._heartbeat.start()
         self._resource_monitor.start()
         self._telemetry_reporter.start()
@@ -648,6 +664,10 @@ class ElasticTrainingAgent:
             # slow-path backstop
             if self._heartbeat.master_unreachable or not self._client.ping():
                 self._ride_through_master_outage()
+            # master-side diagnosis: a hang verdict naming THIS host
+            # triggers a local flight-recorder dump (the worker's own
+            # detector may be the thing that's stuck)
+            self._poll_diagnosis()
             # check membership changes
             if self._membership_changed():
                 logger.info("membership changed; restarting workers")
@@ -659,6 +679,29 @@ class ElasticTrainingAgent:
             if self._heartbeat.action == "restart":
                 self._heartbeat.action = ""
                 self._restart_workers()
+
+    def _poll_diagnosis(self):
+        """Best-effort: fetch the master's runtime verdicts; when a
+        hang diagnosis names this host, dump the flight recorder once
+        per episode so the post-mortem exists even if the stuck worker
+        can never write its own."""
+        try:
+            result = self._client.get_diagnosis()
+        except Exception:  # noqa: BLE001 - diagnosis is advisory
+            return
+        hangs = getattr(result, "hangs", None) or {}
+        info = hangs.get(self._config.node_rank)
+        if info is None:
+            self._hang_episode_dumped = False
+            return
+        if self._hang_episode_dumped:
+            return
+        self._hang_episode_dumped = True
+        telemetry.event(
+            "diagnosis.hang.received",
+            rank=self._config.node_rank, **info,
+        )
+        flight.dump("hang-diagnosis", diagnosis=info)
 
     def _membership_changed(self) -> bool:
         try:

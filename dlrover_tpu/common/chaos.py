@@ -58,7 +58,7 @@ import threading
 import time
 from collections import deque
 
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import telemetry, tracing
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -156,9 +156,14 @@ class ChaosRule:
                 site, ctx, self.exit_code,
             )
             try:
-                # os._exit skips atexit: persist the telemetry snapshot
-                # NOW or the kill (and everything before it) vanishes
-                # from the merged timeline
+                # os._exit skips atexit AND signal handlers: dump the
+                # flight recorder (last spans/events + thread stacks)
+                # and persist the telemetry snapshot NOW, or the kill
+                # (and everything before it) vanishes from both the
+                # merged timeline and the post-mortem
+                from dlrover_tpu.common import flight
+
+                flight.dump("chaos-kill", site=site, chaos_ctx=ctx)
                 telemetry.flush()
             except Exception:  # noqa: BLE001 - dying anyway
                 pass
@@ -221,9 +226,16 @@ class ChaosRegistry:
                     self.fired.append((site, rule.action, dict(ctx)))
                     key = f"{site}:{rule.action}"
                     self._counts[key] = self._counts.get(key, 0) + 1
+                    # tag the fire with the ACTIVE trace/span: a fault
+                    # injected mid-restore (or mid-rendezvous) is then
+                    # attributable to the exact span it perturbed in
+                    # the obs_report --trace view
+                    span_ctx = tracing.current() or {}
                     telemetry.event(
                         "chaos.fire", site=site, action=rule.action,
                         step=ctx.get("step"),
+                        trace=span_ctx.get("trace", ""),
+                        span=span_ctx.get("span", ""),
                     )
                     telemetry.counter_inc(
                         "chaos.fires", site=site, action=rule.action

@@ -494,6 +494,24 @@ class DiagnosisReport(Message):
     tag: str = ""
 
 
+@dataclass
+class DiagnosisRequest(Message):
+    """Query the master's runtime diagnosis (master/diagnosis.py):
+    current straggler and hang verdicts. Agents poll it each monitor
+    tick; one naming this agent's host as hanging triggers a local
+    flight-recorder dump."""
+
+    node_rank: int = -1
+
+
+@dataclass
+class DiagnosisResult(Message):
+    # node_rank -> {"phase": blamed phase, "ratio": ..., "z": ...}
+    stragglers: dict = field(default_factory=dict)
+    # node_rank -> {"stalled_s": ..., "last_step": ...}
+    hangs: dict = field(default_factory=dict)
+
+
 # --------------------------------------------------------------------------
 # telemetry (metrics registry snapshots + job-wide report)
 # --------------------------------------------------------------------------

@@ -10,8 +10,15 @@ external deps, and the same semantics: ``report`` returns a success ack,
 ``get`` returns a message.
 
 Frame layout:  [u32 body_len][body]
-Body layout :  pickled tuple (verb, node_type, node_id, message)
+Body layout :  pickled tuple (verb, node_type, node_id, message[, trace])
 Response    :  pickled tuple (ok: bool, message_or_error)
+
+``trace`` is the optional 5th element: the caller's ambient trace
+context (``{"trace": ..., "span": ...}``, see common/tracing.py). The
+client injects it whenever a span is active; the server adopts it
+around dispatch so master-side spans parent under the caller's — one
+causal tree across processes. 4-element bodies (older clients, or no
+active span) stay fully supported.
 """
 
 from __future__ import annotations
@@ -21,7 +28,7 @@ import socketserver
 import threading
 import time
 
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import telemetry, tracing
 from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.framing import (
     recv_frame as _recv_frame,
@@ -49,6 +56,15 @@ class RpcService:
         raise NotImplementedError
 
 
+# Servicer-side latency buckets: local control-plane RPCs sit in the
+# 0.1-10 ms band, so the shared multi-minute DEFAULT_BUCKETS would put
+# every observation in the first bucket and p99 would be unresolvable.
+SERVER_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         sock = self.request
@@ -59,21 +75,42 @@ class _Handler(socketserver.BaseRequestHandler):
                 body = _recv_frame(sock)
             except (ConnectionError, OSError):
                 return
+            msg_type = ""
+            t0 = time.perf_counter()
+            verb = ""
             try:
-                verb, node_type, node_id, message = deserialize_message(body)
-                if verb == "get":
-                    result = service.get(node_type, node_id, message)
-                    reply = (True, result)
-                elif verb == "report":
-                    ok = service.report(node_type, node_id, message)
-                    reply = (bool(ok), None)
-                elif verb == "ping":
-                    reply = (True, "pong")
-                else:
-                    reply = (False, f"unknown verb {verb!r}")
+                envelope = deserialize_message(body)
+                # 5th element = propagated trace context (older clients
+                # send 4); adopt it around dispatch so any span opened
+                # while serving parents under the caller's span
+                trace_ctx = envelope[4] if len(envelope) > 4 else None
+                verb, node_type, node_id, message = envelope[:4]
+                msg_type = type(message).__name__
+                with tracing.attach(trace_ctx):
+                    if verb == "get":
+                        result = service.get(node_type, node_id, message)
+                        reply = (True, result)
+                    elif verb == "report":
+                        ok = service.report(node_type, node_id, message)
+                        reply = (bool(ok), None)
+                    elif verb == "ping":
+                        reply = (True, "pong")
+                    else:
+                        reply = (False, f"unknown verb {verb!r}")
             except Exception as e:  # noqa: BLE001 - fault barrier
                 logger.exception("rpc dispatch error")
                 reply = (False, f"{type(e).__name__}: {e}")
+            # per-verb/message servicer latency: the control-plane
+            # surface (master_rpc_p99_ms, joins_per_sec) the bench and
+            # obs_report publish, and the baseline the future swarm
+            # harness regresses against
+            telemetry.observe(
+                "master.rpc.seconds",
+                time.perf_counter() - t0,
+                buckets=SERVER_BUCKETS,
+                verb=verb or "?",
+                msg=msg_type or "?",
+            )
             try:
                 _send_frame(sock, serialize_message(reply))
             except (ConnectionError, OSError):
@@ -220,7 +257,17 @@ class RpcClient:
         NEVER across backoff sleeps — so one dead master stalls a caller
         thread for at most one attempt, not the whole retry window.
         """
-        body = serialize_message((verb, node_type, node_id, message))
+        # trace propagation: captured ONCE per logical call (not per
+        # attempt), so a retried/reconnected call keeps the same parent
+        # and a master restarted mid-retry still parents its spans
+        # correctly — the context lives here, not in master state
+        trace_ctx = tracing.wire_context()
+        envelope = (
+            (verb, node_type, node_id, message)
+            if trace_ctx is None
+            else (verb, node_type, node_id, message, trace_ctx)
+        )
+        body = serialize_message(envelope)
         policy = self.policy
         if retries is not None:
             policy = policy.with_attempts(retries)

@@ -118,6 +118,74 @@ class _Histogram:
         self.count += 1
 
 
+def median_baseline(values) -> float:
+    """The fleet-baseline convention shared by the probe-round
+    straggler rule (``rendezvous.get_stragglers``) and the runtime
+    diagnosis (``master/diagnosis.py``): true median (middle value, or
+    mean of the two middles), EXCEPT with exactly two hosts the faster
+    one is the baseline — otherwise the slow host's own time dominates
+    the median and a >k x-median rule can never fire. One definition so
+    the two rules cannot drift."""
+    values = sorted(values)
+    n = len(values)
+    if not n:
+        return 0.0
+    if n == 2:
+        return values[0]
+    if n % 2 == 1:
+        return values[n // 2]
+    return (values[n // 2 - 1] + values[n // 2]) / 2
+
+
+def sum_bucket_counts(hists):
+    """Element-wise sum of le-bucket histogram series (snapshot-dict
+    shape: ``{"bounds": [...], "counts": [...]}``). The first series'
+    bounds win; series with mismatched bounds are skipped rather than
+    mis-merged. Returns ``(bounds, counts)`` — ``(None, None)`` when
+    the input is empty. Shared by every surface that collapses
+    per-label series into one quantile (bench, obs_report)."""
+    hists = list(hists)
+    if not hists:
+        return None, None
+    bounds = hists[0]["bounds"]
+    counts = [0] * (len(bounds) + 1)
+    for h in hists:
+        if h["bounds"] != bounds:
+            continue
+        counts = [a + b for a, b in zip(counts, h["counts"])]
+    return bounds, counts
+
+
+def hist_quantile(bounds, counts, q: float) -> float:
+    """Estimate the ``q``-quantile (0..1) of a le-bucket histogram by
+    linear interpolation inside the containing bucket (the Prometheus
+    ``histogram_quantile`` rule).
+
+    ``counts`` has ``len(bounds) + 1`` entries, the last being +Inf.
+    Observations in the +Inf bucket clamp to the last finite bound (no
+    upper edge to interpolate toward); an empty histogram returns NaN.
+    """
+    bounds = list(bounds)
+    counts = list(counts)
+    total = sum(counts)
+    if total <= 0 or not bounds:
+        return float("nan")
+    q = min(max(q, 0.0), 1.0)
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        prev_cum = cum
+        cum += c
+        if cum < target or c == 0:
+            continue
+        if i >= len(bounds):
+            return float(bounds[-1])  # +Inf bucket: clamp
+        lo = bounds[i - 1] if i > 0 else 0.0
+        hi = bounds[i]
+        return lo + (hi - lo) * ((target - prev_cum) / c)
+    return float(bounds[-1])
+
+
 class TelemetryRegistry:
     """One per process. All hooks funnel here; ``snapshot()`` serializes
     the whole state (cumulative — re-merging the same snapshot is
@@ -134,7 +202,13 @@ class TelemetryRegistry:
         self.created = time.time()
         self.created_mono = time.monotonic()
         self.role = os.environ.get(ENV_ROLE, "proc")
-        rank = os.environ.get("RANK") or os.environ.get("NODE_RANK") or "0"
+        # NODE rank, not global worker RANK: every diagnosis consumer
+        # (straggler/hang verdicts, exclude_straggler, flight-dump
+        # targeting) operates in the node-rank domain, and with
+        # nproc_per_node > 1 the two differ — keying worker snapshots
+        # by global RANK would blame the wrong host. The pid keeps
+        # sources unique across a node's workers and restarts.
+        rank = os.environ.get("NODE_RANK") or os.environ.get("RANK") or "0"
         self.source = source or f"{self.role}-{rank}-{os.getpid()}"
 
     # ------------------------------------------------------------- metrics
@@ -184,31 +258,63 @@ class TelemetryRegistry:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
-                "format": SNAPSHOT_FORMAT,
-                "source": self.source,
-                "role": self.role,
-                "pid": os.getpid(),
-                "created": self.created,
-                "now": time.time(),
-                "counters": self._metric_list(self._counters),
-                "gauges": self._metric_list(self._gauges),
-                "histograms": [
-                    {
-                        "name": name,
-                        "labels": dict(labels),
-                        "bounds": list(h.bounds),
-                        "counts": list(h.counts),
-                        "sum": h.sum,
-                        "count": h.count,
-                    }
-                    for (name, labels), h in sorted(self._hists.items())
-                ],
-                "events": [dict(e) for e in self._events],
-                # no silent truncation: the ring is bounded, and a merge
-                # must be able to tell "quiet" from "overwrote the tail"
-                "events_dropped": self._dropped,
-            }
+            return self._snapshot_locked()
+
+    def snapshot_best_effort(self, lock_timeout: float = 1.0) -> dict:
+        """Snapshot that can run in a SIGNAL HANDLER: a handler runs on
+        the main thread between bytecodes, so if the signal interrupted
+        this very thread inside a registry hook, ``snapshot()`` would
+        self-deadlock on the non-reentrant lock. Bounded acquire, then
+        a lockless read as last resort — a torn copy of a dying
+        process's metrics beats a process that never dies."""
+        acquired = self._lock.acquire(timeout=max(lock_timeout, 0.0))
+        try:
+            try:
+                return self._snapshot_locked()
+            except RuntimeError:
+                # the unlocked read raced a writer (deque/dict mutated
+                # during iteration): degrade to the envelope alone
+                pass
+        finally:
+            if acquired:
+                self._lock.release()
+        return {
+            "format": SNAPSHOT_FORMAT,
+            "source": self.source,
+            "role": self.role,
+            "pid": os.getpid(),
+            "created": self.created,
+            "now": time.time(),
+            "counters": [], "gauges": [], "histograms": [],
+            "events": [], "events_dropped": self._dropped,
+        }
+
+    def _snapshot_locked(self) -> dict:
+        return {
+            "format": SNAPSHOT_FORMAT,
+            "source": self.source,
+            "role": self.role,
+            "pid": os.getpid(),
+            "created": self.created,
+            "now": time.time(),
+            "counters": self._metric_list(self._counters),
+            "gauges": self._metric_list(self._gauges),
+            "histograms": [
+                {
+                    "name": name,
+                    "labels": dict(labels),
+                    "bounds": list(h.bounds),
+                    "counts": list(h.counts),
+                    "sum": h.sum,
+                    "count": h.count,
+                }
+                for (name, labels), h in sorted(self._hists.items())
+            ],
+            "events": [dict(e) for e in self._events],
+            # no silent truncation: the ring is bounded, and a merge
+            # must be able to tell "quiet" from "overwrote the tail"
+            "events_dropped": self._dropped,
+        }
 
     def flush(self, path: str | None = None) -> str | None:
         """Write the snapshot JSON atomically. Default destination is
@@ -273,6 +379,15 @@ def snapshot() -> dict | None:
     if reg is None:
         return None
     return reg.snapshot()
+
+
+def snapshot_best_effort(lock_timeout: float = 1.0) -> dict | None:
+    """Signal-handler-safe snapshot (see
+    :meth:`TelemetryRegistry.snapshot_best_effort`)."""
+    reg = _REGISTRY
+    if reg is None:
+        return None
+    return reg.snapshot_best_effort(lock_timeout)
 
 
 def flush(path: str | None = None) -> str | None:
@@ -638,12 +753,23 @@ def format_report(report: dict, timeline_tail: int = 40) -> str:
     hists = metrics.get("histograms", [])
     if hists:
         lines.append("")
-        lines.append("=== histograms ===")
+        lines.append("=== histograms (ms) ===")
+        lines.append(
+            f"{'obs':>8}  {'avg':>9}  {'p50':>9}  {'p95':>9}  "
+            f"{'p99':>9}  name"
+        )
         for h in hists:
             label_s = ",".join(f"{k}={v}" for k, v in h["labels"].items())
             avg = h["sum"] / h["count"] if h["count"] else 0.0
+            # quantiles interpolated within le-buckets, not raw bucket
+            # counts: the operator-facing latency surface
+            p50, p95, p99 = (
+                hist_quantile(h["bounds"], h["counts"], q)
+                for q in (0.5, 0.95, 0.99)
+            )
             lines.append(
-                f"{h['count']:8d} obs  avg {avg * 1e3:9.3f} ms  {h['name']}"
+                f"{h['count']:8d}  {avg * 1e3:9.3f}  {p50 * 1e3:9.3f}  "
+                f"{p95 * 1e3:9.3f}  {p99 * 1e3:9.3f}  {h['name']}"
                 + (f"{{{label_s}}}" if label_s else "")
             )
     profile = report.get("profile")

@@ -30,6 +30,10 @@ class SpeedMonitor:
         self._running_workers: set = set()
         self._waiting_restart_workers: set = set()
         self._max_speed = 0.0
+        # (node_type, node_id) -> (timestamp, step): per-NODE progress,
+        # so the diagnosis layer can blame the specific stalled host
+        # instead of only answering the job-level "is anyone moving"
+        self._node_steps: dict = {}
 
     @property
     def running_workers(self):
@@ -56,11 +60,17 @@ class SpeedMonitor:
         with self._lock:
             self._running_workers.discard((node_type, node_id))
 
-    def collect_global_step(self, step: int, timestamp: float | None = None):
+    def collect_global_step(
+        self, step: int, timestamp: float | None = None, node=None,
+    ):
         timestamp = timestamp or time.time()
         with self._lock:
             if self._start_training_time == 0:
                 self._start_training_time = timestamp
+            if node is not None:
+                prev = self._node_steps.get(node)
+                if prev is None or timestamp >= prev[0]:
+                    self._node_steps[node] = (timestamp, step)
             if step >= self._global_step:
                 self._global_step = step
                 self._global_step_records.append((timestamp, step))
@@ -68,6 +78,32 @@ class SpeedMonitor:
         speed = self.running_speed
         if speed > self._max_speed:
             self._max_speed = speed
+
+    def node_progress(self) -> dict:
+        """(node_type, node_id) -> (last_report_time, last_step) for
+        every node that ever reported a step."""
+        with self._lock:
+            return dict(self._node_steps)
+
+    def stalled_nodes(self, window: float, now: float | None = None) -> list:
+        """Nodes whose last step report is older than ``window`` while
+        at least one other node kept progressing — the per-node
+        complement of :meth:`all_worker_hanged`. ``now`` lets a caller
+        evaluate every staleness check against one clock reading."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if len(self._node_steps) < 2:
+                return []
+            fresh = [
+                t for t, _ in self._node_steps.values()
+                if now - t <= window
+            ]
+            if not fresh:
+                return []  # everyone stalled: job-level, not per-node
+            return sorted(
+                node for node, (t, _) in self._node_steps.items()
+                if now - t > window
+            )
 
     @property
     def running_speed(self) -> float:
@@ -101,3 +137,6 @@ class SpeedMonitor:
         with self._lock:
             self._global_step_records.clear()
             self._sample_count = 0
+            # membership changed: stale per-node stamps from departed
+            # workers must not read as hangs in the new round
+            self._node_steps.clear()

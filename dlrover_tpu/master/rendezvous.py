@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 import time
 
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import telemetry, tracing
 from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import (
     JobConstant,
@@ -119,23 +119,30 @@ class RendezvousManager:
         self, node_rank: int, local_world_size: int, node_ip: str = "",
         verified_ckpt_step: int = -1, verified_ckpt_steps=None,
     ) -> int:
-        # master-side fault site: a dropped/delayed join is the server
-        # half of a flaky control plane (the client half is rpc.send)
-        chaos_point("rdzv.join", rank=node_rank, name=self.name)
-        telemetry.event(
-            "rdzv.join", rank=node_rank, name=self.name,
-            verified_step=verified_ckpt_step,
-        )
-        with self._lock:
-            if not self._waiting_nodes:
-                self._first_join_time = time.time()
-            self._waiting_nodes[node_rank] = (local_world_size, node_ip)
-            self._verified_steps[node_rank] = self._step_set(
-                verified_ckpt_step, verified_ckpt_steps
+        # master-side span: the RPC handler attached the joining
+        # agent's trace context, so this nests under its rdzv.round
+        with tracing.span(
+            "rdzv.join.handle", rank=node_rank, rdzv=self.name
+        ):
+            # master-side fault site: a dropped/delayed join is the
+            # server half of a flaky control plane (client: rpc.send)
+            chaos_point("rdzv.join", rank=node_rank, name=self.name)
+            telemetry.event(
+                "rdzv.join", rank=node_rank, name=self.name,
+                verified_step=verified_ckpt_step,
             )
-            # joining invalidates the current formed round
-            self._rdzv_nodes = {}
-            return self._rdzv_round
+            with self._lock:
+                if not self._waiting_nodes:
+                    self._first_join_time = time.time()
+                self._waiting_nodes[node_rank] = (
+                    local_world_size, node_ip
+                )
+                self._verified_steps[node_rank] = self._step_set(
+                    verified_ckpt_step, verified_ckpt_steps
+                )
+                # joining invalidates the current formed round
+                self._rdzv_nodes = {}
+                return self._rdzv_round
 
     def num_nodes_waiting(self) -> int:
         """>0 means a membership change is pending — agents restart their
@@ -165,6 +172,10 @@ class RendezvousManager:
 
     def _form_round(self):
         """Called under lock when ready: freeze waiting set into a world."""
+        with tracing.span("rdzv.form_round", rdzv=self.name):
+            self._form_round_traced()
+
+    def _form_round_traced(self):
         ranks = self._truncate_to_unit(list(self._waiting_nodes.keys()))
         self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
         self._latest_rdzv_nodes = ranks
@@ -489,22 +500,27 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self, node_rank: int, local_world_size: int, node_ip: str = "",
         verified_ckpt_step: int = -1, verified_ckpt_steps=None,
     ) -> int:
-        chaos_point("rdzv.join", rank=node_rank, name=self.name)
-        telemetry.event(
-            "rdzv.join", rank=node_rank, name=self.name,
-            verified_step=verified_ckpt_step,
-        )
-        with self._lock:
-            if not self._waiting_nodes:
-                self._first_join_time = time.time()
-                self._fault_nodes.clear()
-                self._stragglers.clear()
-            self._waiting_nodes[node_rank] = (local_world_size, node_ip)
-            self._verified_steps[node_rank] = self._step_set(
-                verified_ckpt_step, verified_ckpt_steps
+        with tracing.span(
+            "rdzv.join.handle", rank=node_rank, rdzv=self.name
+        ):
+            chaos_point("rdzv.join", rank=node_rank, name=self.name)
+            telemetry.event(
+                "rdzv.join", rank=node_rank, name=self.name,
+                verified_step=verified_ckpt_step,
             )
-            self._rdzv_nodes = {}
-            return self._rdzv_round
+            with self._lock:
+                if not self._waiting_nodes:
+                    self._first_join_time = time.time()
+                    self._fault_nodes.clear()
+                    self._stragglers.clear()
+                self._waiting_nodes[node_rank] = (
+                    local_world_size, node_ip
+                )
+                self._verified_steps[node_rank] = self._step_set(
+                    verified_ckpt_step, verified_ckpt_steps
+                )
+                self._rdzv_nodes = {}
+                return self._rdzv_round
 
     def network_check_success(self) -> tuple[bool, str]:
         """All nodes of the round reported and none is faulty."""
@@ -589,26 +605,17 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         }
 
     def get_stragglers(self) -> tuple[list[int], bool]:
-        """Straggler = elapsed > 2x median of the round (reference
-        _detect_stragglers :505). Returns (stragglers, round_complete).
-
-        True median (middle value, or mean of the two middles for even
-        counts); for exactly 2 nodes the faster node is the reference —
-        otherwise the slow node's own time dominates the median and the
-        rule can never fire."""
+        """Straggler = elapsed > 2x the fleet baseline of the round
+        (reference _detect_stragglers :505; baseline convention shared
+        with the runtime diagnosis via
+        :func:`~dlrover_tpu.common.telemetry.median_baseline`).
+        Returns (stragglers, round_complete)."""
         with self._lock:
             rnd = self._check_round
             times = self._node_times_by_round.get(rnd, {})
             if len(times) < len(self._latest_rdzv_nodes) or not times:
                 return sorted(self._stragglers), False
-            values = sorted(times.values())
-            n = len(values)
-            if n == 2:
-                baseline = values[0]
-            elif n % 2 == 1:
-                baseline = values[n // 2]
-            else:
-                baseline = (values[n // 2 - 1] + values[n // 2]) / 2
+            baseline = telemetry.median_baseline(times.values())
             self._stragglers = {
                 r
                 for r, t in times.items()

@@ -12,6 +12,7 @@ import threading
 import time
 
 from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common import tracing
 from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import get_logger
@@ -147,6 +148,15 @@ class MasterServicer(RpcService):
         # job-wide telemetry merge: agents ship registry snapshots, the
         # report query serves the goodput ledger + merged timeline
         self.telemetry = JobTelemetry()
+        # runtime straggler/hang diagnosis over the merged telemetry
+        # (per-host TimerRing phase gauges + step.end activity); checks
+        # are pull-driven from heartbeats and diagnosis queries
+        from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+        self.diagnosis = DiagnosisManager(
+            self.telemetry,
+            speed_monitor=getattr(task_manager, "speed_monitor", None),
+        )
         # durable control-plane state (master failover); set by the
         # owning JobMaster when a state dir is configured
         self.state_store = None
@@ -217,10 +227,28 @@ class MasterServicer(RpcService):
                 nodes=fault_nodes,
             )
         if isinstance(message, msg.StragglerExistRequest):
+            # two sources, merged: the network-check probe-time rule
+            # (only populated during dedicated probe rounds) and the
+            # runtime diagnosis over live telemetry — check_straggler
+            # now answers DURING training instead of from the probe-
+            # round-only stub
             mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
             stragglers, done = mgr.get_stragglers()
+            diagnosed = self.diagnosis.stragglers()
+            nodes = sorted(set(stragglers) | set(diagnosed))
+            blame = ";".join(
+                f"{rank}:{info.get('phase', '?')}"
+                for rank, info in sorted(diagnosed.items())
+            )
             return msg.NetworkCheckResult(
-                normal=done, nodes=stragglers, reason=""
+                normal=done or bool(diagnosed), nodes=nodes,
+                reason=blame,
+            )
+        if isinstance(message, msg.DiagnosisRequest):
+            verdicts = self.diagnosis.check()
+            return msg.DiagnosisResult(
+                stragglers=verdicts["stragglers"],
+                hangs=verdicts["hangs"],
             )
         if isinstance(message, msg.KeyValueGetRequest):
             value = self.kv_store.get(message.key)
@@ -236,6 +264,10 @@ class MasterServicer(RpcService):
             action = self.job_manager.update_node_heartbeat(
                 node_type, node_id, message.timestamp
             )
+            # heartbeats are the master's steady pulse: piggyback the
+            # (rate-limited) diagnosis sweep on them so verdicts stay
+            # fresh without a dedicated scanner thread
+            self.diagnosis.check()
             return msg.HeartbeatResponse(action=action or "")
         if isinstance(message, msg.ParallelConfigRequest):
             return self._get_paral_config(node_type, node_id)
@@ -382,8 +414,12 @@ class MasterServicer(RpcService):
         if isinstance(message, msg.GlobalStep):
             if self._start_training_time == 0:
                 self._start_training_time = time.time()
+            # node identity threaded through so per-node progress is
+            # trackable (hang diagnosis second source) — the message
+            # itself predates diagnosis and stays unchanged
             self.task_manager.speed_monitor.collect_global_step(
-                message.step, message.timestamp
+                message.step, message.timestamp,
+                node=(node_type, node_id),
             )
             return True
         if isinstance(message, msg.NodeFailure):
@@ -472,6 +508,17 @@ class MasterServicer(RpcService):
         ) or len(nodes)
 
     def _get_task(self, node_type, node_id, request: msg.TaskRequest):
+        # child of the worker's shard.fetch span (context propagated in
+        # the RPC envelope): dispatch + WAL land in one shard trace
+        with tracing.span(
+            "shard.dispatch", node=f"{node_type}-{node_id}",
+            dataset=request.dataset_name,
+        ) as sp:
+            task = self._get_task_traced(node_type, node_id, request)
+            sp.annotate(task_id=task.task_id)
+            return task
+
+    def _get_task_traced(self, node_type, node_id, request):
         task = self.task_manager.get_dataset_task(
             node_type, node_id, request.dataset_name
         )
@@ -502,18 +549,19 @@ class MasterServicer(RpcService):
         )
 
     def _report_task_result(self, result: msg.TaskResult) -> bool:
-        success = not result.err_message
-        ok = self.task_manager.report_dataset_task(
-            result.dataset_name, result.task_id, success
-        )
-        if ok or not success:
-            self._wal(
-                "task_result",
-                ds=result.dataset_name,
-                task_id=result.task_id,
-                success=success,
+        with tracing.span("shard.result", task_id=result.task_id):
+            success = not result.err_message
+            ok = self.task_manager.report_dataset_task(
+                result.dataset_name, result.task_id, success
             )
-        return ok
+            if ok or not success:
+                self._wal(
+                    "task_result",
+                    ds=result.dataset_name,
+                    task_id=result.task_id,
+                    success=success,
+                )
+            return ok
 
     def _get_comm_world(self, request: msg.CommWorldRequest):
         mgr = self.rdzv_managers.get(request.rdzv_name)

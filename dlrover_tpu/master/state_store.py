@@ -44,7 +44,7 @@ import os
 import threading
 import time
 
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import telemetry, tracing
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -116,6 +116,7 @@ class MasterStateStore:
         in-memory mutation it describes and *before* the RPC ack —
         that ordering is what makes snapshot+replay lossless."""
         rec = {"op": op, **fields}
+        t0 = time.perf_counter()
         with self._wal_lock:
             if self._wal_file is None:
                 self._wal_file = open(  # noqa: SIM115 - long-lived handle
@@ -129,6 +130,15 @@ class MasterStateStore:
             # a process-failure model
             self._wal_file.flush()
             self._wal_lines += 1
+        # a histogram, not a span: the append sits on the RPC ack path
+        # of every mutation — its latency distribution is exactly what
+        # the future WAL-group-commit work must drive down, and a span
+        # per append would flood the event ring
+        telemetry.observe(
+            "master.wal.append.seconds",
+            time.perf_counter() - t0,
+            op=op,
+        )
         self.mark_dirty()
 
     def _read_wal(self) -> list[dict]:
@@ -210,7 +220,7 @@ class MasterStateStore:
         return state
 
     def write_snapshot(self) -> str | None:
-        with self._snap_lock:
+        with tracing.span("master.snapshot") as sp, self._snap_lock:
             state = self.collect()
             tmp = f"{self._snap_path}.tmp.{os.getpid()}"
             try:
@@ -221,6 +231,7 @@ class MasterStateStore:
                 logger.warning("master state snapshot failed: %s", e)
                 return None
             self.snapshots_written += 1
+            sp.annotate(wal_seq=state["wal_seq"])
         self._maybe_compact(state["wal_seq"])
         return self._snap_path
 

@@ -111,6 +111,17 @@ class HangingDetector:
                         "no training progress for %.0fs (step %s): "
                         "hang suspected", stalled, self._last_step,
                     )
+                    # post-mortem FIRST, report second: the flight
+                    # record (last spans/events + every thread's stack,
+                    # incl. whatever the main thread is stuck in) is
+                    # the evidence; the report/relaunch may destroy it
+                    from dlrover_tpu.common import flight
+
+                    flight.dump(
+                        "hang-detector",
+                        stalled_s=round(stalled, 3),
+                        last_step=self._last_step,
+                    )
                     if self._client is not None:
                         try:
                             self._client.report_failure(

@@ -49,7 +49,7 @@ from dlrover_tpu.agent.ckpt_saver import (
     read_host_shard,
     verify_step_dir,
 )
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import telemetry, tracing
 from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import CheckpointConstant, NodeEnv
 from dlrover_tpu.common.ipc import SharedLock, SharedQueue
@@ -487,6 +487,10 @@ class CheckpointEngine:
     def save_to_memory(self, step: int, state_dict) -> bool:
         """Write the state into shm; ~the only blocking time the training
         loop sees. Returns False if skipped (saver busy)."""
+        with tracing.span("ckpt.save.shm", step=step):
+            return self._save_to_memory_traced(step, state_dict)
+
+    def _save_to_memory_traced(self, step: int, state_dict) -> bool:
         start = time.time()
         if not self._shm_lock.acquire(blocking=False):
             logger.warning(
@@ -603,10 +607,13 @@ class CheckpointEngine:
 
     def save_to_storage(self, step: int, state_dict, path: str = "") -> bool:
         """Shm write (blocking) + async persistence in the agent."""
-        if not self.save_to_memory(step, state_dict):
-            return False
-        self._notify(SaveEvent(step=step, path=path, storage_type="disk"))
-        return True
+        with tracing.span("ckpt.save", step=step, persist=True):
+            if not self.save_to_memory(step, state_dict):
+                return False
+            self._notify(
+                SaveEvent(step=step, path=path, storage_type="disk")
+            )
+            return True
 
     def _notify(self, event: SaveEvent):
         if self._event_queue is not None:
@@ -689,6 +696,14 @@ class CheckpointEngine:
         used only if it holds exactly that step, and storage candidates
         are capped at it — every host of the round restores the SAME
         step even when some hold newer local state."""
+        # restore span: shm/storage stage spans and any chaos fire
+        # perturbing the restore nest under it in the trace view
+        with tracing.span("ckpt.restore.load"):
+            return self._load_traced(path, target, zero_copy)
+
+    def _load_traced(
+        self, path: str = "", target=None, zero_copy: bool = False
+    ):
         t0 = time.monotonic()
         self.last_restore_stats = {}
         consensus = self._consensus_restore_step()
@@ -789,6 +804,12 @@ class CheckpointEngine:
         _publish_restore_stats(self.last_restore_stats)
 
     def _load_from_memory(self, target=None, zero_copy: bool = False):
+        with tracing.span("ckpt.restore.shm"):
+            return self._load_from_memory_traced(target, zero_copy)
+
+    def _load_from_memory_traced(
+        self, target=None, zero_copy: bool = False
+    ):
         result = self._shm_handler.read()
         if result is None:
             return None
@@ -925,6 +946,12 @@ class CheckpointEngine:
         targeted shard-wise loader does crc-less slice reads, so its
         candidates get the deep payload-crc verify.
         """
+        with tracing.span("ckpt.restore.storage"):
+            return self._load_from_storage_traced(path, target, max_step)
+
+    def _load_from_storage_traced(
+        self, path: str = "", target=None, max_step: int | None = None,
+    ):
         candidates = [path] if path else self._candidate_step_dirs()
         self.last_restore_stats = {}
         if not path and max_step is not None:

@@ -15,6 +15,7 @@ import contextlib
 import os
 import time
 
+from dlrover_tpu.common import tracing
 from dlrover_tpu.common.ipc import get_or_create_shm
 from dlrover_tpu.native import TimerRing
 
@@ -76,9 +77,14 @@ class StepTimer:
 
     @contextlib.contextmanager
     def time(self, tag: int):
+        """Time a phase into the shm ring AND emit it as a trace span
+        (``phase.<tag>``): the ring feeds the out-of-process exporter /
+        straggler diagnosis, the span feeds the causal trace view —
+        same instant, two consumers."""
         t0 = time.time_ns()
         try:
-            yield
+            with tracing.span(f"phase.{Tag.NAMES.get(tag, tag)}"):
+                yield
         finally:
             self._ring.push(tag, t0, time.time_ns() - t0)
 

@@ -18,7 +18,7 @@ import os
 import time
 from typing import Any, Callable, Iterable, Optional
 
-from dlrover_tpu.common import telemetry
+from dlrover_tpu.common import flight, telemetry, tracing
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.accelerate import auto_accelerate
 from dlrover_tpu.parallel.strategy import Strategy
@@ -285,6 +285,9 @@ class Trainer:
         import jax
 
         args = self.args
+        # post-mortem coverage for the worker: a SIGTERM (preemption,
+        # agent stop) dumps the last spans/events + thread stacks
+        flight.install()
         resumed = self.maybe_resume()
         metrics = {}
         shm_saves = 0
@@ -308,23 +311,40 @@ class Trainer:
             if sampler is not None and hasattr(sampler, "set_epoch"):
                 if epoch != start_epoch:
                     sampler.set_epoch(epoch)
-            for batch in self.train_data:
+            data_iter = iter(self.train_data)
+            while True:
+                # the host input pipeline's stall is a first-class
+                # diagnosis phase (data_wait vs compute vs ckpt blame):
+                # time the iterator pull into the shm ring
+                t_wait = time.time_ns()
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    break
+                wait_ns = time.time_ns() - t_wait
+                if self._timer is not None:
+                    self._timer.record(Tag.DATA_WAIT, t_wait, wait_ns)
                 if self._profiler is not None:
                     self._profiler.maybe_start(self.global_step)
                 t0 = time.time_ns()
-                rng = jax.random.fold_in(
-                    jax.random.key(args.seed), self.global_step
-                )
-                if self.prestep is not None:
-                    self.state, batch = self.prestep(self.state, batch)
-                self.state, metrics = self._accel.train_step(
-                    self.state, batch, rng
-                )
-                self.global_step += 1
-                if self._profiler is not None:
-                    self._profiler.maybe_stop(
-                        self.global_step - 1, block_on=metrics
+                with tracing.span(
+                    "train.step", step=self.global_step + 1
+                ):
+                    rng = jax.random.fold_in(
+                        jax.random.key(args.seed), self.global_step
                     )
+                    if self.prestep is not None:
+                        self.state, batch = self.prestep(
+                            self.state, batch
+                        )
+                    self.state, metrics = self._accel.train_step(
+                        self.state, batch, rng
+                    )
+                    self.global_step += 1
+                    if self._profiler is not None:
+                        self._profiler.maybe_stop(
+                            self.global_step - 1, block_on=metrics
+                        )
                 dur_ns = time.time_ns() - t0
                 if self._timer is not None:
                     self._timer.record(Tag.STEP, t0, dur_ns)

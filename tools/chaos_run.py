@@ -354,7 +354,7 @@ def main() -> int:
     reg = chaos.active_registry()
     if reg is not None:
         print(f"agent-side chaos fires: {reg.summary()}")
-    from dlrover_tpu.common import telemetry
+    from dlrover_tpu.common import flight, telemetry
     from dlrover_tpu.common.telemetry import JobTelemetry, format_report
 
     telemetry.flush()  # this (agent/master) process's snapshot
@@ -368,7 +368,18 @@ def main() -> int:
             print(
                 "\nfull report: python tools/obs_report.py --dir "
                 + os.environ["DLROVER_TELEMETRY_DIR"]
+                + "\nspan traces: python tools/obs_report.py --trace "
+                "--dir " + os.environ["DLROVER_TELEMETRY_DIR"]
             )
+    # post-mortems: kill schedules (chaos kill, SIGTERM, hang verdicts)
+    # leave flight-recorder dumps — the victim's last spans/events plus
+    # all-thread stacks — one file each, listed here so the post-mortem
+    # is one command away
+    dumps = flight.list_dumps(os.environ["DLROVER_TELEMETRY_DIR"])
+    if dumps:
+        print("\nflight-recorder dumps:")
+        for p in dumps:
+            print("  " + p)
     print(f"work dir: {out_dir}" + ("" if args.keep else " (removing)"))
     if not args.keep and not args.out_dir:
         shutil.rmtree(out_dir, ignore_errors=True)

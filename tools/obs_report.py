@@ -9,6 +9,10 @@ Usage:
     # from a live master (the servicer's telemetry query)
     python tools/obs_report.py --master 127.0.0.1:12345
 
+    # render the cross-host span trees (rendezvous rounds, restores,
+    # shard dispatches — parent/child nesting across processes)
+    python tools/obs_report.py --dir ... --trace
+
     # embed the XPlane per-category breakdown when a trace exists
     python tools/obs_report.py --dir ... --trace-dir out/profile --steps 3
 
@@ -56,6 +60,9 @@ def build_report(
     # raw snapshots are an input detail, not operator output
     report.pop("snapshots", None)
     report["restore"] = _restore_summary(report.get("metrics", {}))
+    report["control_plane"] = _control_plane_summary(
+        report.get("metrics", {}), report.get("ledger", {})
+    )
     if trace_dir:
         try:
             from tools.parse_profile import summarize
@@ -67,6 +74,54 @@ def build_report(
             # take the goodput report down with it
             report["profile_error"] = f"trace parse failed: {e}"
     return report
+
+
+def _control_plane_summary(metrics: dict, ledger: dict) -> dict:
+    """The master's control-plane latency surface: per-verb servicer
+    histograms (``master.rpc.seconds``) collapsed into the headline
+    keys — ``master_rpc_p99_ms`` and ``joins_per_sec`` — the baseline
+    future swarm-scale work regresses against."""
+    from dlrover_tpu.common.telemetry import (
+        hist_quantile,
+        sum_bucket_counts,
+    )
+
+    hists = [
+        h for h in metrics.get("histograms", ())
+        if h["name"] == "master.rpc.seconds"
+    ]
+    bounds, overall = sum_bucket_counts(hists)
+    if bounds is None:
+        return {}
+    per_verb: dict = {}
+    joins = 0
+    for h in hists:
+        if h["bounds"] != bounds:
+            continue
+        per_verb.setdefault(h["labels"].get("verb", "?"), []).append(h)
+        if h["labels"].get("msg") == "JoinRendezvousRequest":
+            joins += h["count"]
+    per_verb = {
+        verb: sum_bucket_counts(series)[1]
+        for verb, series in per_verb.items()
+    }
+    total_s = float(ledger.get("total_s") or 0.0)
+    out = {
+        "master_rpc_calls": sum(overall),
+        "master_rpc_p50_ms": round(
+            hist_quantile(bounds, overall, 0.50) * 1e3, 3
+        ),
+        "master_rpc_p99_ms": round(
+            hist_quantile(bounds, overall, 0.99) * 1e3, 3
+        ),
+        "joins_total": joins,
+        "joins_per_sec": round(joins / total_s, 3) if total_s > 0 else 0.0,
+    }
+    for verb, counts in sorted(per_verb.items()):
+        out[f"rpc_{verb}_p99_ms"] = round(
+            hist_quantile(bounds, counts, 0.99) * 1e3, 3
+        )
+    return out
 
 
 def _restore_summary(metrics: dict) -> dict:
@@ -95,6 +150,10 @@ def main(argv=None) -> int:
         help="live master address host:port (telemetry servicer query)",
     )
     parser.add_argument(
+        "--trace", action="store_true",
+        help="render the cross-host span trees (causal trace view)",
+    )
+    parser.add_argument(
         "--trace-dir", help="XPlane trace dir to embed a profile summary"
     )
     parser.add_argument(
@@ -121,6 +180,11 @@ def main(argv=None) -> int:
         return 1
     if args.json:
         print(json.dumps(report, indent=2))
+    elif args.trace:
+        from dlrover_tpu.common.tracing import format_trace
+
+        print("=== span traces (cross-host, parent/child nested) ===")
+        print(format_trace(report.get("timeline", [])))
     else:
         from dlrover_tpu.common.telemetry import format_report
 
@@ -130,6 +194,15 @@ def main(argv=None) -> int:
             print("\n=== checkpoint data path ===")
             for name in sorted(restore):
                 print(f"{restore[name]:14.3f}  {name}")
+        control = report.get("control_plane") or {}
+        if control:
+            print("\n=== control plane (master RPC surface) ===")
+            for name in sorted(control):
+                v = control[name]
+                print(
+                    f"{v:14.3f}  {name}" if isinstance(v, float)
+                    else f"{v:14d}  {name}"
+                )
         if report.get("profile_error"):
             print(f"\n[profile skipped: {report['profile_error']}]",
                   file=sys.stderr)
