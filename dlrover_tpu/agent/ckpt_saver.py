@@ -476,6 +476,7 @@ def verify_step_dir(step_dir: str, deep: bool = True) -> tuple[bool, str]:
         try:
             already_verified.update(newly_verified)
             tmp = marker_path + f".tmp.{os.getpid()}"
+            # dlint: allow-chaos(best-effort verify cache: a torn/corrupt marker fails json.load and only costs a re-crc; sizes are cross-checked against the manifest on every read)
             with open(tmp, "w") as f:
                 json.dump({"files": already_verified}, f)
             os.replace(tmp, marker_path)
@@ -774,12 +775,31 @@ class AsyncCheckpointSaver:
 
         def handler(signum, frame):  # noqa: ARG001
             saver = cls._saver_instance
+            # no logging from signal context (dlint DL004, the PR-6
+            # bug shape): the handler may have interrupted the main
+            # thread while it holds the logging module's non-reentrant
+            # handler lock — write to the raw fd instead
             if saver is not None:
-                logger.info("SIGTERM: flushing shm checkpoint to storage")
+                # stderr may be a pipe to an already-dead parent (the
+                # very teardown this handler serves): a raised EPIPE
+                # here must not abort the flush or the 143 exit
                 try:
+                    os.write(
+                        2,
+                        b"SIGTERM: flushing shm checkpoint to storage\n",
+                    )
+                except OSError:
+                    pass
+                try:
+                    # eviction-time best-effort flush: its locks are
+                    # saver-thread-owned, never main-thread, so they
+                    # can block here but not self-deadlock
                     saver.save_shm_to_storage()
                 except Exception:  # noqa: BLE001
-                    logger.exception("SIGTERM flush failed")
+                    try:
+                        os.write(2, b"SIGTERM shm flush failed\n")
+                    except OSError:
+                        pass
             raise SystemExit(143)
 
         signal.signal(signal.SIGTERM, handler)

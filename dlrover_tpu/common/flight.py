@@ -127,6 +127,7 @@ def dump(reason: str, _quiet: bool = False, **extra) -> str | None:
             json.dump(record, f)
         os.replace(tmp, path)
         if not _quiet:
+            # dlint: allow-signal(guarded: the signal path passes _quiet=True, so this never runs from handler context)
             logger.warning(
                 "flight recorder dumped (%s): %s", reason, path
             )
@@ -134,6 +135,7 @@ def dump(reason: str, _quiet: bool = False, **extra) -> str | None:
     except Exception:  # noqa: BLE001 - a post-mortem writer must never
         # become the thing that kills (or un-kills) the process
         if not _quiet:
+            # dlint: allow-signal(guarded by _quiet — see above)
             logger.warning("flight-recorder dump failed", exc_info=True)
         return None
 

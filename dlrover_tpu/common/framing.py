@@ -17,6 +17,7 @@ MAX_FRAME = 1 << 30
 def recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
+        # dlint: allow-chaos(transport under the rpc.recv site: every caller reaches this through RpcClient.call / the server handler, where the chaos points live)
         chunk = sock.recv(n - len(buf))
         if not chunk:
             raise ConnectionError("peer closed connection")
@@ -25,6 +26,7 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def send_frame(sock: socket.socket, payload: bytes):
+    # dlint: allow-chaos(transport under the rpc.send site — see recv_exact)
     sock.sendall(HDR.pack(len(payload)) + payload)
 
 

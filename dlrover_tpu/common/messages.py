@@ -4,6 +4,12 @@ Equivalent capability: reference dlrover/python/common/grpc.py:129-450 —
 ~45 pickled dataclass message types carried by a 2-RPC (report/get)
 protocol. Same two-verb shape here: every client interaction is either a
 ``report`` (fire-and-ack) or a ``get`` (request-response).
+
+Drift discipline: every dataclass here must have a live endpoint —
+``tools/dlint`` (DL006) statically checks that anything the client
+sends has a servicer dispatch arm and that no dead types linger (ten
+never-referenced reference-parity placeholders were deleted when the
+checker landed).
 """
 
 from __future__ import annotations
@@ -19,13 +25,6 @@ class Message:
 # --------------------------------------------------------------------------
 # generic / envelope
 # --------------------------------------------------------------------------
-
-
-@dataclass
-class BaseRequest(Message):
-    node_id: int = -1
-    node_type: str = ""
-    data: bytes = b""
 
 
 @dataclass
@@ -131,12 +130,6 @@ class VerifiedStepsReport(Message):
 
 
 @dataclass
-class RendezvousState(Message):
-    round: int = 0
-    waiting_num: int = 0
-
-
-@dataclass
 class CommWorldRequest(Message):
     node_id: int = 0
     rdzv_name: str = ""
@@ -232,14 +225,6 @@ class HeartbeatResponse(Message):
 
 
 @dataclass
-class TPUStats(Message):
-    index: int = 0
-    memory_used_gb: float = 0.0
-    memory_total_gb: float = 0.0
-    duty_cycle_pct: float = 0.0
-
-
-@dataclass
 class ResourceStats(Message):
     node_id: int = 0
     cpu_percent: float = 0.0
@@ -258,26 +243,6 @@ class NodeMeta(Message):
     tpu_chips: int = 0
 
 
-@dataclass
-class NodeEventMessage(Message):
-    node_type: str = ""
-    node_id: int = 0
-    event_type: str = ""
-    exit_reason: str = ""
-
-
-@dataclass
-class ClusterVersionRequest(Message):
-    task_type: str = ""
-    task_id: int = 0
-    version_type: str = ""
-
-
-@dataclass
-class ClusterVersion(Message):
-    version: int = 0
-
-
 # --------------------------------------------------------------------------
 # training progress / metrics
 # --------------------------------------------------------------------------
@@ -287,23 +252,6 @@ class ClusterVersion(Message):
 class GlobalStep(Message):
     timestamp: float = 0.0
     step: int = 0
-
-
-@dataclass
-class DatasetMetric(Message):
-    dataset_name: str = ""
-    dataset_size: int = 0
-    batch_size: int = 0
-    epoch: int = 0
-
-
-@dataclass
-class ModelInfo(Message):
-    num_params: int = 0
-    flops_per_step: float = 0.0
-    hidden_size: int = 0
-    num_layers: int = 0
-    seq_len: int = 0
 
 
 # --------------------------------------------------------------------------
@@ -321,13 +269,6 @@ class DataLoaderConfig(Message):
 
 
 @dataclass
-class OptimizerConfig(Message):
-    optimizer_name: str = ""
-    learning_rate: float = 0.0
-    version: int = 0
-
-
-@dataclass
 class ParallelConfigRequest(Message):
     pass
 
@@ -335,7 +276,6 @@ class ParallelConfigRequest(Message):
 @dataclass
 class ParallelConfig(Message):
     dataloader: DataLoaderConfig = field(default_factory=DataLoaderConfig)
-    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     restart: bool = False
     # TPU: the mesh/sharding strategy the master asks workers to adopt on
     # the next restart (serialized accel.Strategy), if any.
@@ -477,14 +417,6 @@ class ElasticRunConfigRequest(Message):
 @dataclass
 class ElasticRunConfig(Message):
     configs: dict = field(default_factory=dict)
-
-
-@dataclass
-class ScaleRequest(Message):
-    """Manual scale request (the ScalePlan-CR equivalent)."""
-
-    node_type: str = ""
-    count: int = 0
 
 
 @dataclass

@@ -280,6 +280,7 @@ class RpcClient:
             chaos_point(
                 "rpc.send", verb=verb, msg=msg_type, attempt=attempt
             )
+            # dlint: allow-blocking(the lock scope IS the contract: held only around one round-trip, released across backoff sleeps — see class docstring)
             with self._lock:
                 # budget computed under the lock: time spent queued
                 # behind another thread's attempt must come out of THIS
@@ -357,6 +358,7 @@ def addr_connectable(addr: str, timeout: float = 3.0) -> bool:
     (elastic_run.py:258)."""
     host, _, port = addr.rpartition(":")
     try:
+        # dlint: allow-chaos(pure reachability probe: a failure IS the signal; faults belong on rpc.send/rpc.recv where retries engage)
         with socket.create_connection(
             (host or "127.0.0.1", int(port)), timeout=timeout
         ):

@@ -17,6 +17,7 @@ import threading
 from abc import ABC, abstractmethod
 from concurrent.futures import ThreadPoolExecutor
 
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import get_logger
 
@@ -107,12 +108,17 @@ class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
                 if s % self._keep_interval != 0
                 and s < step  # never the just-committed or newer steps
             ]
-            for rm_step in candidates:
-                path = _step_dir(self._checkpoint_dir, rm_step)
-                try:
-                    delete_func(path)
-                except Exception as e:  # noqa: BLE001
-                    logger.warning(f"fail to clean {path}: {e}")
+        # delete OUTSIDE the lock (dlint DL002): step dirs are
+        # multi-GB and an rmtree under the lock stalls every other
+        # shard thread's commit for the whole disk walk. Concurrent
+        # double-deletes are safe — delete_func tolerates a vanished
+        # path and disk remains the source of truth.
+        for rm_step in candidates:
+            path = _step_dir(self._checkpoint_dir, rm_step)
+            try:
+                delete_func(path)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"fail to clean {path}: {e}")
 
 
 class KeepLatestStepStrategy(CheckpointDeletionStrategy):
@@ -138,12 +144,15 @@ class KeepLatestStepStrategy(CheckpointDeletionStrategy):
             victims = [s for s in steps if s < step]
             keep_slots = max(self._max_to_keep - len(protected), 0)
             excess = victims[: max(len(victims) - keep_slots, 0)]
-            for rm_step in excess:
-                path = _step_dir(self._checkpoint_dir, rm_step)
-                try:
-                    delete_func(path)
-                except Exception as e:  # noqa: BLE001
-                    logger.warning(f"fail to clean {path}: {e}")
+        # delete OUTSIDE the lock (dlint DL002, see
+        # KeepStepIntervalStrategy.clean_up): the victim choice above
+        # is the critical section, the rmtree is not
+        for rm_step in excess:
+            path = _step_dir(self._checkpoint_dir, rm_step)
+            try:
+                delete_func(path)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"fail to clean {path}: {e}")
 
 
 class CheckpointStorage(ABC):
@@ -218,6 +227,11 @@ class PosixDiskStorage(CheckpointStorage):
         self._deletion_strategy = deletion_strategy
 
     def write(self, content, path: str):
+        # raw persist seam (dlint DL003): every byte that reaches disk
+        # through this class passes a chaos site first, so schedules
+        # can error/delay/hang the storage layer itself — not only the
+        # payload-transform sites (ckpt.write) above it
+        chaos_point("storage.write", path=path)
         mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
@@ -232,6 +246,7 @@ class PosixDiskStorage(CheckpointStorage):
     _PARALLEL_PART_BYTES = 64 << 20
 
     def write_parts(self, parts, path: str):
+        chaos_point("storage.write", path=path)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
         parts = list(parts)
@@ -289,6 +304,7 @@ class PosixDiskStorage(CheckpointStorage):
         can never observe the header-less intermediate."""
         from dlrover_tpu import native as dlrtpu_native
 
+        chaos_point("storage.write", path=path)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
         crc = 0

@@ -10,6 +10,7 @@ import argparse
 import os
 import sys
 
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import PlatformType
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.master import DistributedJobMaster, LocalJobMaster
@@ -112,6 +113,10 @@ def run(args) -> int:
     master.prepare()
     addr = f"127.0.0.1:{master.port}"
     if args.addr_file:
+        # the addr file is how agents re-resolve a restarted master
+        # (dlint DL003): a schedule can delay/error the publish to
+        # exercise the ride-through window
+        chaos_point("master.addrfile", addr=addr)
         tmp = f"{args.addr_file}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(addr)

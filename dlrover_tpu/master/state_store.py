@@ -45,6 +45,7 @@ import threading
 import time
 
 from dlrover_tpu.common import telemetry, tracing
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -116,6 +117,10 @@ class MasterStateStore:
         in-memory mutation it describes and *before* the RPC ack —
         that ordering is what makes snapshot+replay lossless."""
         rec = {"op": op, **fields}
+        # durable-write seam (dlint DL003): schedules can error/delay/
+        # hang the WAL append — the exact outage shape a master crash
+        # between mutation and ack produces
+        chaos_point("master.wal", op=op)
         t0 = time.perf_counter()
         with self._wal_lock:
             if self._wal_file is None:
@@ -128,6 +133,7 @@ class MasterStateStore:
             # flush to the kernel: survives the process (chaos kill via
             # os._exit included); media-level fsync is out of scope for
             # a process-failure model
+            # dlint: allow-blocking(mutate->append->flush->ack ordering is the WAL's durability contract; flushing outside the lock would let a later record ack first)
             self._wal_file.flush()
             self._wal_lines += 1
         # a histogram, not a span: the append sits on the RPC ack path
@@ -220,6 +226,7 @@ class MasterStateStore:
         return state
 
     def write_snapshot(self) -> str | None:
+        chaos_point("master.snapshot")
         with tracing.span("master.snapshot") as sp, self._snap_lock:
             state = self.collect()
             tmp = f"{self._snap_path}.tmp.{os.getpid()}"

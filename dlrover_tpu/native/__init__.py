@@ -35,11 +35,61 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)
 )))
 _SRC_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.path.join(_SRC_DIR, "build", "libdlrtpu.so")
+
+# Sanitizer selection (DLROVER_TPU_NATIVE_SANITIZE, read ONCE at
+# import): each variant builds to its own suffixed filename — matching
+# native/Makefile's asan/ubsan/tsan targets — so a sanitized build can
+# never mix with a normal one in native/build/, and the stale-source
+# rebuild logic below applies per variant. Loading a sanitized .so
+# into an unsanitized python needs the runtime preloaded (see
+# tests/test_native_sanitized.py for the LD_PRELOAD recipe).
+_SAN_FLAGS = {
+    "asan": ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"],
+    "ubsan": [
+        "-fsanitize=undefined", "-fno-sanitize-recover=undefined", "-g",
+    ],
+    "asan-ubsan": [
+        "-fsanitize=address,undefined",
+        "-fno-sanitize-recover=undefined",
+        "-fno-omit-frame-pointer", "-g",
+    ],
+    "tsan": ["-fsanitize=thread", "-g"],
+}
+_SAN_ALIASES = {
+    "address": "asan", "undefined": "ubsan", "thread": "tsan",
+    "asan,ubsan": "asan-ubsan", "ubsan,asan": "asan-ubsan",
+    "address,undefined": "asan-ubsan",
+}
+
+
+def _resolve_san_tag(raw: str) -> str:
+    tag = raw.strip().lower().replace(" ", "")
+    tag = _SAN_ALIASES.get(tag, tag)
+    if tag and tag not in _SAN_FLAGS:
+        logger.warning(
+            "unknown DLROVER_TPU_NATIVE_SANITIZE=%r (want one of %s); "
+            "using the normal build", raw, sorted(_SAN_FLAGS),
+        )
+        return ""
+    return tag
+
+
+_SAN_TAG = _resolve_san_tag(
+    os.environ.get("DLROVER_TPU_NATIVE_SANITIZE", "")
+)
+_LIB_PATH = os.path.join(
+    _SRC_DIR, "build",
+    f"libdlrtpu.{_SAN_TAG}.so" if _SAN_TAG else "libdlrtpu.so",
+)
 
 _lib = None
 _lib_lock = threading.Lock()
 _load_attempted = False
+
+
+def sanitize_tag() -> str:
+    """The active sanitizer variant ('' = normal build)."""
+    return _SAN_TAG
 
 
 class _CopySeg(ctypes.Structure):
@@ -73,7 +123,9 @@ def _try_build() -> bool:
     tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = [
         os.environ.get("CXX", "g++"), "-O3", "-shared", "-fPIC",
-        "-pthread", "-std=c++17", "-o", tmp_path, src,
+        "-pthread", "-std=c++17",
+        *(_SAN_FLAGS.get(_SAN_TAG, ())),
+        "-o", tmp_path, src,
     ]
     try:
         subprocess.run(
@@ -162,7 +214,10 @@ def get_lib():
                     return None
                 lib = ctypes.CDLL(_LIB_PATH)
             _lib = _bind(lib)
-            logger.info("libdlrtpu loaded from %s", _LIB_PATH)
+            logger.info(
+                "libdlrtpu loaded from %s%s", _LIB_PATH,
+                f" (sanitize={_SAN_TAG})" if _SAN_TAG else "",
+            )
         except (OSError, AttributeError) as e:
             logger.warning("libdlrtpu load failed (%s); using fallbacks", e)
             _lib = None

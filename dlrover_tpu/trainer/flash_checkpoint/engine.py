@@ -214,6 +214,7 @@ def pipelined_device_put(tree, stats: dict | None = None):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     out = [None] * len(leaves)
     for i, leaf in enumerate(leaves):
+        # dlint: allow-blocking(device_put only DISPATCHES here — it returns before the transfer completes; serializing dispatch is exactly what this lock is for, the blocking wait is the single barrier below)
         with _H2D_DISPATCH_LOCK:
             out[i] = jax.device_put(leaf)
     jax.block_until_ready(out)
@@ -1235,11 +1236,13 @@ class CheckpointEngine:
                 if isinstance(leaf_t, jax.Array) and hasattr(
                     leaf_t, "sharding"
                 ):
+                    # dlint: allow-blocking(async dispatch only — see pipelined_device_put)
                     with _H2D_DISPATCH_LOCK:
                         host = jax.device_put(host, leaf_t.sharding)
                 elif isinstance(leaf_t, jax.ShapeDtypeStruct):
                     sharding = getattr(leaf_t, "sharding", None)
                     if sharding is not None:
+                        # dlint: allow-blocking(async dispatch only — see pipelined_device_put)
                         with _H2D_DISPATCH_LOCK:
                             host = jax.device_put(host, sharding)
                     else:
@@ -1434,6 +1437,7 @@ def _restore_leaf_to_sharding(pieces, leaf_target, read_box=None):
         # async dispatch under the lock: the transfer itself overlaps
         # the next shard's read (and other leaves' reads — this runs on
         # the restore pool's worker threads)
+        # dlint: allow-blocking(async dispatch only — see pipelined_device_put)
         with _H2D_DISPATCH_LOCK:
             shard_arrays.append(jax.device_put(out, dev))
     with _H2D_DISPATCH_LOCK:

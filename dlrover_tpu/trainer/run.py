@@ -25,6 +25,7 @@ from dlrover_tpu.agent.training_agent import (
     ElasticLaunchConfig,
     launch_agent,
 )
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import addr_connectable, find_free_port
@@ -97,6 +98,9 @@ def _launch_local_master(node_num: int) -> tuple[subprocess.Popen, str]:
     """Spawn a local master subprocess (reference
     _launch_dlrover_local_master :230)."""
     port = find_free_port()
+    # spawn seam (dlint DL003): agent.spawn covers workers; this is
+    # the master-process counterpart
+    chaos_point("master.spawn", port=port)
     proc = subprocess.Popen(  # noqa: S603
         [
             sys.executable,

@@ -19,6 +19,7 @@ import time
 from typing import Any, Callable, Iterable, Optional
 
 from dlrover_tpu.common import flight, telemetry, tracing
+from dlrover_tpu.common.chaos import chaos_point
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.accelerate import auto_accelerate
 from dlrover_tpu.parallel.strategy import Strategy
@@ -527,6 +528,10 @@ class Trainer:
         pending = os.path.join(
             self.args.output_dir, self._PRESTEP_FILES[1]
         )
+        # prestep sidecar seam (dlint DL003): PR 2's pending-then-
+        # promote scheme exists exactly for kills around this write —
+        # make the write itself schedulable too
+        chaos_point("ckpt.prestep", step=self.global_step)
         tmp = pending + ".tmp"
         with open(tmp, "wb") as f:  # np.save(str) appends .npy
             np.save(f, payload, allow_pickle=True)

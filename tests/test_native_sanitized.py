@@ -1,0 +1,190 @@
+"""Native ops under ASan+UBSan (slow tier): the threaded C paths from
+the checkpoint data path — scatter/gather copy, parallel CRC + GF(2)
+combine, page prefault, the seqlock timer ring — rebuilt with
+``-fsanitize=address,undefined`` and re-exercised in a subprocess.
+
+Recipe: a sanitized shared object cannot be dlopen'd into an
+unsanitized CPython unless the sanitizer runtime is already in the
+process, so the subprocess runs with ``LD_PRELOAD=libasan.so
+libubsan.so`` and ``DLROVER_TPU_NATIVE_SANITIZE=asan-ubsan`` (which
+makes the ctypes loader build/load ``build/libdlrtpu.asan-ubsan.so``
+— a separate file, so the sanitized build can never contaminate the
+normal one). ``detect_leaks=0`` because CPython itself leaks;
+``halt_on_error=1`` so any UB turns into a nonzero exit instead of a
+warning this test could miss.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+
+# the subprocess workload: every native op with multi-threading on,
+# results cross-checked against pure-python ground truth
+_WORKLOAD = r"""
+import os, zlib
+import numpy as np
+from dlrover_tpu import native
+
+assert native.sanitize_tag() == "asan-ubsan", native.sanitize_tag()
+assert native.native_available(), "sanitized libdlrtpu failed to load"
+assert native._LIB_PATH.endswith(".asan-ubsan.so"), native._LIB_PATH
+
+rng = np.random.RandomState(7)
+
+# threaded scatter + gather round-trip, chunk-split sizes
+arrays = [
+    rng.randint(0, 255, size=(17 << 20,)).astype(np.uint8),
+    rng.randn(1 << 18).astype(np.float32),
+    rng.randn(333, 77).astype(np.float64),
+]
+total = sum(a.nbytes for a in arrays)
+buf = bytearray(total)
+parts, off = [], 0
+for a in arrays:
+    parts.append((off, a))
+    off += a.nbytes
+assert native.scatter_copy(buf, parts, nthreads=4)
+expected = b"".join(np.ascontiguousarray(a).tobytes() for a in arrays)
+assert bytes(buf) == expected
+
+outs = [np.zeros(a.nbytes, np.uint8) for a in arrays]
+gparts, off = [], 0
+for a, o in zip(arrays, outs):
+    gparts.append((off, o))
+    off += a.nbytes
+assert native.gather_copy(buf, gparts, nthreads=4)
+assert b"".join(o.tobytes() for o in outs) == expected
+
+# parallel CRC + combine vs zlib ground truth
+data = bytes(buf[: 20 << 20])
+assert native.crc32_parallel(data, nthreads=4) == (
+    zlib.crc32(data) & 0xFFFFFFFF
+)
+cut = 11 << 20
+a = zlib.crc32(data[:cut]) & 0xFFFFFFFF
+b = zlib.crc32(data[cut:]) & 0xFFFFFFFF
+assert native.crc32_combine(a, b, len(data) - cut) == (
+    zlib.crc32(data) & 0xFFFFFFFF
+)
+
+# threaded prefault of a fresh buffer
+fresh = bytearray(b"\xff" * (1 << 20))
+assert native.prefault(fresh, nthreads=4)
+assert fresh[0] == 0 and fresh[4096] == 0
+
+# seqlock timer ring: native push/drain + python-fallback interop
+rbuf = bytearray(native.TimerRing.ring_bytes(64))
+ring = native.TimerRing(rbuf, 64)
+for i in range(200):  # wraps the ring several times
+    ring.push(i, i * 10, i)
+recs = ring.drain(max_records=64)
+assert [r[0] for r in recs] == list(range(136, 200)), recs[:3]
+ring._py_push(7, 70, 7)
+assert ring.drain() == [(7, 70, 7)]
+
+print("SANITIZED-NATIVE-OK")
+"""
+
+
+def _runtime_lib(name: str) -> str | None:
+    cc = os.environ.get("CC", "gcc")
+    if shutil.which(cc) is None:
+        return None
+    try:
+        out = subprocess.run(
+            [cc, f"-print-file-name={name}"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    # an unresolved name is echoed back bare; resolved ones are paths
+    return out if os.path.sep in out and os.path.exists(out) else None
+
+
+def _require_toolchain():
+    if shutil.which(os.environ.get("CXX", "g++")) is None:
+        pytest.skip("no C++ toolchain")
+    if _runtime_lib("libasan.so") is None:
+        pytest.skip("libasan runtime unavailable")
+
+
+class TestSanitizedNativeOps:
+    def test_native_ops_under_asan_ubsan(self):
+        _require_toolchain()
+        preload = [_runtime_lib("libasan.so")]
+        ubsan = _runtime_lib("libubsan.so")
+        if ubsan:
+            preload.append(ubsan)
+        env = dict(os.environ)
+        env.update(
+            DLROVER_TPU_NATIVE_SANITIZE="asan-ubsan",
+            LD_PRELOAD=" ".join(preload),
+            # CPython leaks by design; a sanitized helper .so must not
+            # fail the test for them. halt_on_error: UB is an error.
+            ASAN_OPTIONS="detect_leaks=0:abort_on_error=1",
+            UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1",
+        )
+        env.pop("DLROVER_TPU_DISABLE_NATIVE", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", _WORKLOAD],
+            capture_output=True, text=True, timeout=300,
+            env=env, cwd=REPO_ROOT,
+        )
+        blob = proc.stdout + proc.stderr
+        assert proc.returncode == 0, blob[-4000:]
+        assert "SANITIZED-NATIVE-OK" in proc.stdout, blob[-4000:]
+        for marker in ("AddressSanitizer", "runtime error:"):
+            assert marker not in blob, blob[-4000:]
+
+    def test_sanitized_build_is_a_separate_file(self):
+        """The variant suffix keeps sanitized and normal builds from
+        ever mixing in native/build/ — and the loader agrees with the
+        Makefile on the filename."""
+        _require_toolchain()
+        env = dict(os.environ)
+        env["DLROVER_TPU_NATIVE_SANITIZE"] = "address,undefined"  # alias
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from dlrover_tpu import native;"
+             "print(native.sanitize_tag());"
+             "print(native._LIB_PATH)"],
+            capture_output=True, text=True, timeout=120,
+            env=env, cwd=REPO_ROOT,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        tag, lib_path = out.stdout.strip().splitlines()[-2:]
+        assert tag == "asan-ubsan"
+        assert lib_path.endswith(os.path.join(
+            "build", "libdlrtpu.asan-ubsan.so"
+        ))
+
+    def test_makefile_sanitizer_targets(self, tmp_path):
+        """`make asan` / `make ubsan` / `make tsan` produce the
+        variant files the loader expects (built in a scratch copy so
+        the repo's build/ stays untouched)."""
+        _require_toolchain()
+        if shutil.which("make") is None:
+            pytest.skip("make unavailable")
+        scratch = tmp_path / "native"
+        scratch.mkdir()
+        for fname in ("Makefile", "dlrtpu.cc"):
+            shutil.copy(os.path.join(NATIVE_DIR, fname), scratch / fname)
+        for target, lib in [
+            ("asan", "libdlrtpu.asan.so"),
+            ("ubsan", "libdlrtpu.ubsan.so"),
+            ("tsan", "libdlrtpu.tsan.so"),
+        ]:
+            proc = subprocess.run(
+                ["make", "-C", str(scratch), target],
+                capture_output=True, text=True, timeout=180,
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            assert (scratch / "build" / lib).exists()

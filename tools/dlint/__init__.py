@@ -1,0 +1,44 @@
+"""dlint: project-invariant static analysis for dlrover_tpu.
+
+The reference DLRover is an *automatic distributed* system whose
+correctness rests on invariants no unit test states directly: lock
+discipline in the master/agent control plane, every I/O seam being
+chaos-injectable, signal handlers staying async-safe, jitted code
+staying host-sync-free.  Our own history proves review alone does not
+hold them (PR 2: backoff sleeps under the RPC connection lock; PR 6: a
+flight-recorder self-deadlock from logging inside a SIGTERM handler).
+dlint makes them machine-checked: stdlib-``ast`` checkers, structured
+findings fingerprinted and diffed against a committed baseline, gated
+by a tier-1 test.
+
+Checkers (see each module's docstring for the invariant it encodes):
+
+- ``DL001 lock-order``      (:mod:`tools.dlint.locks`)
+- ``DL002 blocking-under-lock`` (:mod:`tools.dlint.locks`)
+- ``DL003 chaos-coverage``  (:mod:`tools.dlint.chaos_cov`)
+- ``DL004 signal-safety``   (:mod:`tools.dlint.sigsafe`)
+- ``DL005 jit-purity``      (:mod:`tools.dlint.jit_purity`)
+- ``DL006 message-drift``   (:mod:`tools.dlint.drift`)
+
+Escape hatch: a ``# dlint: allow-<checker>(reason)`` comment on the
+finding's line (or on the enclosing ``def``/``with`` line) suppresses
+that checker there; the reason is mandatory.  Everything else goes
+through ``tools/dlint/baseline.json`` — documented false positives
+only, each entry carrying a one-line justification.
+"""
+
+from tools.dlint.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    SourceFile,
+    collect_sources,
+    run_checks,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "SourceFile",
+    "collect_sources",
+    "run_checks",
+]

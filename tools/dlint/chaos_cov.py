@@ -1,0 +1,140 @@
+"""DL003 chaos-site coverage.
+
+Invariant: every raw I/O seam in the fault-injectable layers
+(``common/``, ``agent/``, ``master/``, ``trainer/``) is reachable
+through a registered :class:`~dlrover_tpu.common.chaos.ChaosRegistry`
+site — socket ops, write-mode ``open``, and subprocess spawns are
+exactly the places real clusters fail, and PR 2's whole recovery story
+rests on being able to inject faults *there*.  A new seam that dodges
+``chaos_point``/``chaos_transform`` silently escapes every chaos
+schedule, so the checker makes it a finding instead.
+
+Coverage rule (lexical, same-module): a function performing raw I/O is
+covered when it — or any same-module caller within
+:data:`_CALLER_HOPS` hops — contains a ``chaos_point`` /
+``chaos_transform`` call (the site fires on the path into the seam).
+Cross-module coverage (e.g. ``framing.py`` riding under ``rpc.py``'s
+sites) is expressed with ``# dlint: allow-chaos(reason)`` at the seam.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.dlint.astutil import (
+    call_name,
+    index_for,
+    last_attr,
+)
+from tools.dlint.core import Finding
+
+_SCOPE_RE = re.compile(
+    r"dlrover_tpu/(common|agent|master|trainer)/"
+)
+_CALLER_HOPS = 2
+
+_SOCKET_CALLS = {
+    "sendall", "recv", "recv_into", "accept",
+}
+_SUBPROCESS = {
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_output", "subprocess.check_call",
+}
+_CHAOS_MARKERS = {"chaos_point", "chaos_transform"}
+
+
+def _write_mode(call: ast.Call) -> bool:
+    """open(...) with a literal write/append/create/update mode."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(
+        mode.value, str
+    ):
+        return False
+    return any(c in mode.value for c in "wax+")
+
+
+def _seam(call: ast.Call) -> str | None:
+    name = call_name(call)
+    if not name:
+        return None
+    tail = last_attr(name)
+    if name in _SUBPROCESS or name.startswith("os.spawn") or name.startswith(
+        "os.exec"
+    ):
+        return f"subprocess spawn ({name})"
+    if tail in _SOCKET_CALLS:
+        recv = name.rpartition(".")[0].lower()
+        # require a socket-ish receiver: "sock.recv", "self._sock.recv",
+        # "conn.sendall" — queues/pipes named otherwise stay out
+        if "sock" in recv or "conn" in recv or recv == "s":
+            return f"socket op ({name})"
+        return None
+    if tail == "create_connection":
+        return f"socket op ({name})"
+    if name == "open" and _write_mode(call):
+        return "write-mode open"
+    if name == "os.open" and len(call.args) >= 2:
+        flags = ast.dump(call.args[1])
+        if any(f in flags for f in ("O_WRONLY", "O_RDWR", "O_CREAT")):
+            return "write-mode os.open"
+    return None
+
+
+def check_chaos_coverage(sources) -> list[Finding]:
+    findings = []
+    for src in sources:
+        if not _SCOPE_RE.search(src.relpath.replace("\\", "/")):
+            continue
+        index = index_for(src)
+
+        # functions that directly contain a chaos marker (nested defs
+        # are attributed to the enclosing function too — a site inside
+        # a retry closure covers the method that runs the closure)
+        marked = {
+            qual for qual, info in index.functions.items()
+            if any(
+                last_attr(c) in _CHAOS_MARKERS for c in info.calls
+            )
+        }
+        # ...plus everything a marked function can reach within the
+        # hop budget: the site fires on the way into the seam
+        covered = index.reachable(marked, depth=_CALLER_HOPS)
+        # a nested def inherits its enclosing function's coverage
+        for qual in list(covered):
+            prefix = f"{qual}.<locals>."
+            covered.update(
+                q for q in index.functions if q.startswith(prefix)
+            )
+
+        for node in index.all_calls:
+            seam = _seam(node)
+            if seam is None:
+                continue
+            qual = index.enclosing(node.lineno)
+            if qual is not None and qual in covered:
+                continue
+            def_line = (
+                index.functions[qual].node.lineno
+                if qual in index.functions else node.lineno
+            )
+            if src.allowed("chaos", node.lineno, def_line):
+                continue
+            where = qual or "<module>"
+            findings.append(Finding(
+                checker="chaos-coverage", code="DL003",
+                file=src.relpath, line=node.lineno,
+                message=(
+                    f"raw I/O seam not reachable through a chaos "
+                    f"site: {seam} in {where} — register a "
+                    f"chaos_point/chaos_transform on this path or "
+                    f"justify why it is out of scope"
+                ),
+                detail=f"{where}|{seam}",
+            ))
+    return findings

@@ -1,0 +1,283 @@
+"""dlint core: findings, sources, escape-hatch comments, baseline.
+
+Design rules every checker follows:
+
+- **Structured findings.** A finding is (checker id, file, line,
+  message) plus a *stable detail token*; the fingerprint hashes
+  (checker, file, detail) and deliberately excludes the line number,
+  so code motion above a finding does not churn the baseline.
+- **Escape hatch in code.** ``# dlint: allow-<name>(reason)`` on the
+  finding's own line, the enclosing ``with`` line (for lock-scope
+  checkers), or the enclosing ``def`` line (whole-function scope)
+  suppresses the named checker there.  A bare ``allow`` (no name)
+  suppresses every checker on that line.  The parenthesized reason is
+  mandatory: an allow without one is itself a finding (DL000), so the
+  escape hatch can never silently rot into a blanket mute.
+- **Baseline for the rest.** Anything not fixed and not allowed in
+  code lives in ``baseline.json`` with a one-line justification; the
+  gate fails on any finding whose fingerprint is absent there, and
+  reports (but does not fail on) stale entries whose code got fixed.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+
+# allow-comment grammar: "# dlint: allow-blocking(reason)" or
+# "# dlint: allow(reason)"; several directives may share one comment,
+# separated by commas or spaces
+_ALLOW_RE = re.compile(
+    r"#\s*dlint:\s*(?P<body>[^#]*)"
+)
+_DIRECTIVE_RE = re.compile(
+    r"allow(?:-(?P<name>[a-z0-9-]+))?(?:\((?P<reason>[^)]*)\))?"
+)
+
+ALLOW_ALL = "all"
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str      # short name, e.g. "blocking-under-lock"
+    code: str         # stable id, e.g. "DL002"
+    file: str         # path relative to the repo root
+    line: int
+    message: str
+    # stable token for the fingerprint (falls back to the message)
+    detail: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        token = self.detail or self.message
+        raw = f"{self.code}|{self.file}|{token}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint,
+            "code": self.code,
+            "checker": self.checker,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+class SourceFile:
+    """One parsed source file shared by every checker (parse once)."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> {checker-name or ALLOW_ALL: reason}
+        self.allows: dict[int, dict[str, str]] = {}
+        self.bad_allows: list[int] = []  # allow directives missing a reason
+        self._scan_allows()
+
+    def _scan_allows(self):
+        if "dlint:" not in self.text:
+            return  # tokenizing every file would dominate the runtime
+        # tokenize (not line.split("#")) so a "#" inside a string
+        # literal can never be misread as a comment
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _ALLOW_RE.search(tok.string)
+                if not m:
+                    continue
+                lineno = tok.start[0]
+                # a standalone comment governs the NEXT line (the
+                # statement it annotates); a trailing comment governs
+                # its own line
+                line_text = (
+                    self.lines[lineno - 1]
+                    if lineno - 1 < len(self.lines) else ""
+                )
+                standalone = not line_text[: tok.start[1]].strip()
+                targets = (lineno, lineno + 1) if standalone else (lineno,)
+                for d in _DIRECTIVE_RE.finditer(m.group("body")):
+                    if not d.group(0).startswith("allow"):
+                        continue
+                    name = d.group("name") or ALLOW_ALL
+                    reason = (d.group("reason") or "").strip()
+                    if not reason:
+                        self.bad_allows.append(lineno)
+                        continue
+                    for ln in targets:
+                        self.allows.setdefault(ln, {})[name] = reason
+        except tokenize.TokenError:
+            pass
+
+    def allowed(self, checker: str, *linenos: int) -> bool:
+        """True when any of the given lines carries an allow for this
+        checker (or a bare allow)."""
+        for ln in linenos:
+            entry = self.allows.get(ln)
+            if entry and (checker in entry or ALLOW_ALL in entry):
+                return True
+        return False
+
+
+def collect_sources(paths, repo_root: str) -> list[SourceFile]:
+    """Every parseable .py file under ``paths``, sorted for stable
+    output. Caches nothing: a full parse of the tree is <1s."""
+    seen: dict[str, SourceFile] = {}
+    for base in paths:
+        base = os.path.abspath(base)
+        if os.path.isfile(base):
+            candidates = [base]
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", "build")
+                ]
+                candidates.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames if f.endswith(".py")
+                )
+        for path in candidates:
+            rel = os.path.relpath(path, repo_root)
+            if rel in seen:
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+                seen[rel] = SourceFile(path, rel, text)
+            except (OSError, SyntaxError, ValueError):
+                continue  # unparseable files are not this tool's job
+    return [seen[rel] for rel in sorted(seen)]
+
+
+def _allow_findings(sources) -> list[Finding]:
+    out = []
+    for src in sources:
+        for ln in src.bad_allows:
+            out.append(Finding(
+                checker="allow-syntax",
+                code="DL000",
+                file=src.relpath,
+                line=ln,
+                message=(
+                    "dlint allow directive without a reason — write "
+                    "'# dlint: allow-<checker>(why)'"
+                ),
+                detail=f"bad-allow:{ln}",
+            ))
+    return out
+
+
+def run_checks(paths, repo_root: str | None = None,
+               checkers=None) -> list[Finding]:
+    """Run every checker (or ``checkers``, a list of names) over the
+    sources under ``paths``; returns deduplicated, sorted findings."""
+    from tools.dlint import chaos_cov, drift, jit_purity, locks, sigsafe
+
+    repo_root = repo_root or os.getcwd()
+    sources = collect_sources(paths, repo_root)
+    registry = {
+        "lock-order": locks.check_lock_order,
+        "blocking-under-lock": locks.check_blocking_under_lock,
+        "chaos-coverage": chaos_cov.check_chaos_coverage,
+        "signal-safety": sigsafe.check_signal_safety,
+        "jit-purity": jit_purity.check_jit_purity,
+        "message-drift": drift.check_message_drift,
+    }
+    findings = _allow_findings(sources)
+    for name, fn in registry.items():
+        if checkers is not None and name not in checkers:
+            continue
+        findings.extend(fn(sources))
+    # dedupe on fingerprint (two lexical paths can reach one invariant)
+    uniq: dict[str, Finding] = {}
+    for f in findings:
+        uniq.setdefault(f.fingerprint, f)
+    return sorted(
+        uniq.values(), key=lambda f: (f.file, f.line, f.code)
+    )
+
+
+# ---------------------------------------------------------------- baseline
+
+
+@dataclass
+class Baseline:
+    """The committed set of *documented false positives*.
+
+    Each entry: fingerprint -> {code, file, message, note}; ``note``
+    is the one-line justification and is mandatory (an unjustified
+    baseline defeats the point of having one)."""
+
+    path: str
+    entries: dict[str, dict] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        entries: dict[str, dict] = {}
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            for e in data.get("findings", []):
+                entries[e["fingerprint"]] = e
+        return cls(path=path, entries=entries)
+
+    def save(self):
+        data = {
+            "version": 1,
+            "findings": sorted(
+                self.entries.values(),
+                key=lambda e: (e.get("file", ""), e["fingerprint"]),
+            ),
+        }
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, self.path)
+
+    def diff(self, findings) -> tuple[list[Finding], list[dict]]:
+        """-> (new findings not baselined, stale entries whose code
+        got fixed)."""
+        current = {f.fingerprint for f in findings}
+        new = [f for f in findings if f.fingerprint not in self.entries]
+        stale = [
+            e for fp, e in sorted(self.entries.items())
+            if fp not in current
+        ]
+        return new, stale
+
+    def update(self, findings, note: str = "baselined (justify me)",
+               prune: bool = True):
+        """Absorb ``findings`` (keeping existing notes); with ``prune``
+        also drop stale entries. ``prune=False`` is for partial runs
+        (``--checker`` / explicit paths): entries outside the run's
+        scope are not stale, just unobserved — replacing the whole
+        baseline there would destroy their justifications."""
+        fresh: dict[str, dict] = {} if prune else dict(self.entries)
+        for f in findings:
+            prev = self.entries.get(f.fingerprint)
+            entry = f.to_dict()
+            entry.pop("line", None)  # lines drift; fingerprints don't
+            entry["note"] = prev.get("note", note) if prev else note
+            fresh[f.fingerprint] = entry
+        self.entries = fresh
+
+    def unjustified(self) -> list[dict]:
+        return [
+            e for e in self.entries.values()
+            if not str(e.get("note", "")).strip()
+            or "justify me" in str(e.get("note", ""))
+        ]

@@ -1,0 +1,189 @@
+"""DL006 message/servicer drift.
+
+Invariant: the wire protocol (``common/messages.py``) and its two
+endpoints — the master servicer's dispatch (``master/servicer.py``)
+and the agent client (``agent/master_client.py``) — evolve together.
+The payloads are allowlisted pickles, so a message the client sends
+but the servicer never ``isinstance``-dispatches fails only at
+runtime, with a logged "unhandled message" and a None/False the caller
+may misread as a soft failure.  The checker closes that gap statically:
+
+- **missing arm**: a message constructed in ``master_client.py`` (the
+  sending seam) with no ``isinstance`` arm in the servicer — unless
+  the servicer itself constructs it (then it is a response type).
+- **unknown message**: ``msg.X`` referenced in servicer or client
+  where ``X`` is not defined in ``messages.py`` (an AttributeError
+  waiting for the first call).
+- **dead message**: a dataclass in ``messages.py`` referenced nowhere
+  else in the scanned tree — either a handler was never wired or the
+  message should be deleted.
+
+Intentional one-sided messages carry ``# dlint: allow-drift(reason)``
+on the dataclass line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dlint.astutil import call_name, dotted
+from tools.dlint.core import Finding
+
+_MSG_MODULE_NAMES = {"msg", "messages"}
+
+
+def _message_classes(src) -> dict[str, int]:
+    """name -> lineno for every dataclass transitively derived from
+    Message in messages.py."""
+    bases: dict[str, list[str]] = {}
+    linenos: dict[str, int] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef):
+            bases[node.name] = [
+                dotted(b) for b in node.bases if dotted(b)
+            ]
+            linenos[node.name] = node.lineno
+    derived = {"Message"}
+    changed = True
+    while changed:
+        changed = False
+        for name, bs in bases.items():
+            if name in derived:
+                continue
+            if any(b.rpartition(".")[2] in derived for b in bs):
+                derived.add(name)
+                changed = True
+    derived.discard("Message")
+    return {n: linenos[n] for n in derived}
+
+
+def _msg_refs(src) -> tuple[set[str], set[str], set[str]]:
+    """-> (referenced, constructed, isinstance-dispatched) message
+    names in one file, via ``msg.X``/``messages.X`` or from-imports."""
+    from tools.dlint.astutil import index_for
+
+    index = index_for(src)
+    imported: set[str] = set()
+    for node in index.all_imports:
+        if node.module and node.module.endswith("messages"):
+            imported.update(
+                a.name for a in node.names if a.name != "*"
+            )
+    referenced: set[str] = set(imported)
+    constructed: set[str] = set()
+    dispatched: set[str] = set()
+
+    def msg_attr(n) -> str | None:
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                and n.value.id in _MSG_MODULE_NAMES:
+            return n.attr
+        if isinstance(n, ast.Name) and n.id in imported:
+            return n.id
+        return None
+
+    for node in index.all_attrs:
+        name = msg_attr(node)
+        if name:
+            referenced.add(name)
+    for node in index.all_calls:
+        name = msg_attr(node.func)
+        if name:
+            constructed.add(name)
+        if call_name(node) == "isinstance" and len(node.args) == 2:
+            types = node.args[1]
+            elts = (
+                types.elts
+                if isinstance(types, ast.Tuple) else [types]
+            )
+            for t in elts:
+                tn = msg_attr(t)
+                if tn:
+                    dispatched.add(tn)
+    return referenced, constructed, dispatched
+
+
+def check_message_drift(sources) -> list[Finding]:
+    msg_src = next(
+        (s for s in sources
+         if s.relpath.replace("\\", "/").endswith("common/messages.py")),
+        None,
+    )
+    if msg_src is None:
+        return []  # protocol not in scope of this run
+    classes = _message_classes(msg_src)
+
+    servicer = next(
+        (s for s in sources
+         if s.relpath.replace("\\", "/").endswith("master/servicer.py")),
+        None,
+    )
+    client = next(
+        (s for s in sources
+         if s.relpath.replace("\\", "/").endswith("agent/master_client.py")),
+        None,
+    )
+
+    if servicer is None or client is None:
+        # partial run (pre-commit on a path subset): without both
+        # protocol endpoints in scope, reference sets are incomplete
+        # and every live message would look dead — skip the checker
+        # rather than report 50 spurious findings
+        return []
+
+    findings = []
+    all_refs: set[str] = set()
+    for src in sources:
+        if src is msg_src:
+            continue
+        refs, _c, _d = _msg_refs(src)
+        all_refs |= refs
+
+    s_refs, s_constructed, s_dispatched = _msg_refs(servicer)
+    c_refs, c_constructed, _cd = _msg_refs(client)
+    for name in sorted(c_constructed - s_dispatched - s_constructed):
+        if name not in classes:
+            continue  # reported as unknown below
+        line = classes[name]
+        if msg_src.allowed("drift", line):
+            continue
+        findings.append(Finding(
+            checker="message-drift", code="DL006",
+            file=msg_src.relpath, line=line,
+            message=(
+                f"client sends {name} but the servicer has no "
+                f"isinstance dispatch arm for it — the call hits "
+                f"'unhandled message' at runtime"
+            ),
+            detail=f"missing-arm|{name}",
+        ))
+    for src, refs in ((servicer, s_refs), (client, c_refs)):
+        for name in sorted(refs - set(classes)):
+            if name == "Message":
+                continue
+            findings.append(Finding(
+                checker="message-drift", code="DL006",
+                file=src.relpath, line=1,
+                message=(
+                    f"reference to msg.{name} which is not "
+                    f"defined in common/messages.py — "
+                    f"AttributeError on first use"
+                ),
+                detail=f"unknown|{name}",
+            ))
+
+    for name, line in sorted(classes.items()):
+        if name in all_refs:
+            continue
+        if msg_src.allowed("drift", line):
+            continue
+        findings.append(Finding(
+            checker="message-drift", code="DL006",
+            file=msg_src.relpath, line=line,
+            message=(
+                f"message dataclass {name} is referenced nowhere "
+                f"outside messages.py — wire a dispatch arm or "
+                f"delete it"
+            ),
+            detail=f"dead|{name}",
+        ))
+    return findings

@@ -1,0 +1,162 @@
+"""DL005 jit purity.
+
+Invariant: functions handed to ``jax.jit`` / ``pjit`` / ``shard_map``
+must stay host-sync-free.  A ``.item()``, an ``np.asarray`` on a
+tracer argument, a ``time.*`` read, or a ``print`` inside the traced
+body either explodes at trace time or — worse — silently forces a
+device→host sync every step and stalls the hot loop the whole MFU
+push depends on.
+
+Detection: jitted functions are found by decorator (``@jax.jit``,
+``@partial(jax.jit, ...)``) and by call form (``jax.jit(f)``,
+``shard_map(f, ...)`` with ``f`` a same-module function or lambda).
+Inside their bodies (nested defs included — they trace too):
+
+- ``.item()`` — always a host sync inside jit
+- ``np.asarray`` / ``np.array`` / ``np.frombuffer`` **on a function
+  parameter** (a direct tracer; constants built from literals are
+  trace-time and fine)
+- ``time.time`` / ``time.sleep`` / ``time.perf_counter`` / ...
+- ``print`` (``jax.debug.print`` is the traced alternative and is
+  allowed), and ``block_until_ready`` / ``device_put`` / ``device_get``
+
+Trace-time-deliberate host work carries ``# dlint: allow-jit(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dlint.astutil import (
+    call_name,
+    index_for,
+    last_attr,
+)
+from tools.dlint.core import Finding
+
+_JIT_NAMES = {
+    "jit", "jax.jit", "pjit", "jax.pjit",
+    "jax.experimental.pjit.pjit", "shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_TIME_CALLS = {
+    "time", "sleep", "perf_counter", "monotonic", "process_time",
+    "time_ns", "perf_counter_ns", "monotonic_ns",
+}
+_NP_HEADS = {"np", "numpy", "onp"}
+_NP_SYNCS = {"asarray", "array", "frombuffer"}
+
+
+def _is_jit_callee(expr: ast.AST) -> bool:
+    name = call_name(expr) if isinstance(expr, ast.Call) else ""
+    if isinstance(expr, (ast.Name, ast.Attribute)):
+        from tools.dlint.astutil import dotted
+
+        return dotted(expr) in _JIT_NAMES
+    if isinstance(expr, ast.Call):
+        if name in _JIT_NAMES:
+            return True
+        if name in _PARTIAL_NAMES and expr.args:
+            from tools.dlint.astutil import dotted
+
+            return dotted(expr.args[0]) in _JIT_NAMES
+    return False
+
+
+def _jitted_functions(src, index):
+    """Yield (function node, qualname, how) for every function that is
+    jitted by decorator or by a same-module wrap call."""
+    by_name: dict[str, list] = {}
+    for qual, info in index.functions.items():
+        by_name.setdefault(info.name, []).append((qual, info))
+
+    seen: set[int] = set()
+    for qual, info in index.functions.items():
+        for deco in info.node.decorator_list:
+            if _is_jit_callee(deco) and id(info.node) not in seen:
+                seen.add(id(info.node))
+                yield info.node, qual, "decorator"
+
+    for node in index.all_calls:
+        name = call_name(node)
+        if name not in _JIT_NAMES or not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Lambda):
+            if id(target) not in seen:
+                seen.add(id(target))
+                yield target, f"<lambda>@{node.lineno}", "wrap-call"
+        elif isinstance(target, ast.Name):
+            for qual, info in by_name.get(target.id, []):
+                if id(info.node) not in seen:
+                    seen.add(id(info.node))
+                    yield info.node, qual, "wrap-call"
+
+
+def _param_names(fn) -> set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def check_jit_purity(sources) -> list[Finding]:
+    findings = []
+    for src in sources:
+        index = index_for(src)
+        for fn, qual, how in _jitted_functions(src, index):
+            params = _param_names(fn)
+            def_line = getattr(fn, "lineno", 0)
+            body = fn.body if isinstance(body_list := fn.body, list) else [
+                body_list
+            ]
+            nodes = []
+            for stmt in body:
+                nodes.extend(ast.walk(stmt))
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                tail = last_attr(name) if name else ""
+                label = None
+                if tail == "item" and not node.args and "." in name:
+                    label = ".item() host sync"
+                elif name == "print":
+                    label = "print (use jax.debug.print)"
+                elif "." in name and name.rpartition(".")[0] == "time" \
+                        and tail in _TIME_CALLS:
+                    label = f"host clock read ({name})"
+                elif tail in ("block_until_ready",):
+                    label = "block_until_ready device sync"
+                elif tail in ("device_put", "device_get"):
+                    label = f"host transfer ({tail})"
+                elif (
+                    name.rpartition(".")[0] in _NP_HEADS
+                    and tail in _NP_SYNCS
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    label = (
+                        f"{name} on traced argument "
+                        f"'{node.args[0].id}'"
+                    )
+                if label is None:
+                    continue
+                if src.allowed("jit", node.lineno, def_line):
+                    continue
+                findings.append(Finding(
+                    checker="jit-purity", code="DL005",
+                    file=src.relpath, line=node.lineno,
+                    message=(
+                        f"{label} inside jitted function {qual} "
+                        f"({how}) — host syncs stall the compiled "
+                        f"hot loop"
+                    ),
+                    detail=f"{qual}|{tail or name}",
+                ))
+    return findings

@@ -1,0 +1,472 @@
+"""DL001 lock-order + DL002 blocking-under-lock.
+
+Invariants encoded:
+
+- **DL001**: the control plane acquires locks in one global order.
+  Two code paths taking the same pair of locks in opposite order is a
+  deadlock waiting for the right interleaving — the master's servicer
+  threads, the agent's monitor/saver threads, and the trainer all
+  share objects, so the acquisition graph must stay acyclic.  Lock
+  identity is ``Class.attr`` (or ``module.name`` for globals): the
+  checker sees *kinds* of locks, not instances, which is exactly the
+  granularity a reviewer reasons at.
+- **DL002**: nothing that can block on the outside world runs while a
+  lock is held.  PR 2 fixed backoff sleeps under the RPC connection
+  lock; PR 4's review fixed a persist retry spinning under the shm
+  lock.  The checker flags socket ops, file flush/fsync, sleeps,
+  subprocess waits, RPC round-trips (any call on a ``*client*``
+  receiver), and ``device_put`` inside a held-lock region.  Deliberate
+  holds (a WAL whose ack ordering *is* the lock scope) carry
+  ``# dlint: allow-blocking(reason)`` on the ``with`` line.
+
+Both checkers share one lexical lock model: ``with <lock>`` blocks
+plus ``acquire()``/``release()`` line spans, where a lock is any
+expression whose last attribute contains "lock" (refined by
+``threading.Lock/RLock/Condition`` assignments for reentrancy).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.dlint.astutil import (
+    FunctionInfo,
+    call_name,
+    dotted,
+    index_for,
+    last_attr,
+)
+from tools.dlint.core import Finding
+
+# names that contain "lock" but are not locks
+_NON_LOCK_SUFFIXES = (
+    "_path", "_file", "_dir", "_name", "_timeout", "_free", "_key",
+)
+
+# how deep a call chain under a held lock is followed for DL001 edges
+_CALL_DEPTH = 3
+
+# blocking-call classification for DL002: (rule, human label)
+_BLOCKING_LAST = {
+    "sleep": "time.sleep",
+    "fsync": "fsync",
+    "flush": "file flush",
+    "sendall": "socket send",
+    "send": "socket send",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "connect": "socket connect",
+    "accept": "socket accept",
+    "create_connection": "socket connect",
+    "getaddrinfo": "DNS resolution",
+    "communicate": "subprocess wait",
+    "urlopen": "HTTP round-trip",
+    "device_put": "host-to-device transfer",
+    "block_until_ready": "device sync",
+    "run_with_retry": "RPC retry loop",
+    "_call_once": "RPC round-trip",
+    "wait_for_path": "polling wait",
+    "wait_for_persist": "persist wait",
+    "rmtree": "recursive tree deletion",
+    "safe_rmtree": "recursive tree deletion",
+}
+_BLOCKING_DOTTED = {
+    "subprocess.run": "subprocess spawn",
+    "subprocess.call": "subprocess spawn",
+    "subprocess.check_output": "subprocess spawn",
+    "subprocess.check_call": "subprocess spawn",
+}
+
+
+def is_lock_expr(node: ast.AST) -> str | None:
+    """Lock key fragment for a with-item / receiver, or None.
+
+    Matches dotted names (and no-arg calls, e.g. a flock context
+    manager factory) whose final attribute contains "lock"."""
+    if isinstance(node, ast.Call) and not node.args and not node.keywords:
+        inner = dotted(node.func)
+        if inner and _lockish(last_attr(inner)):
+            return inner
+        return None
+    name = dotted(node)
+    if name and _lockish(last_attr(name)):
+        return name
+    return None
+
+
+def _lockish(attr: str) -> bool:
+    low = attr.lower()
+    if "lock" not in low:
+        return False
+    return not low.endswith(_NON_LOCK_SUFFIXES)
+
+
+class _ModuleLocks:
+    """Per-module lock facts: reentrancy + per-function acquisitions +
+    held regions."""
+
+    def __init__(self, src, index: ModuleIndex):
+        self.src = src
+        self.index = index
+        self.modstem = os.path.splitext(
+            os.path.basename(src.relpath)
+        )[0]
+        self.reentrant: set[str] = set()
+        # qualname -> [(lock_key, lineno)] in acquisition order
+        self.acquired: dict[str, list[tuple[str, int]]] = {}
+        # qualname -> [(lock_key, with_line, start, end)] held regions
+        self.regions: dict[str, list[tuple[str, int, int, int]]] = {}
+        # Call nodes inside a lock-with's context expressions: the
+        # acquisition itself, exempt from DL002 (a body call sharing
+        # the `with` line is NOT exempt — one-liners still count)
+        self.with_expr_calls: set[int] = set()
+        # Call nodes inside lambdas: deferred work that runs when the
+        # lambda is invoked, not where it is defined — lexically
+        # inside a lock region but not under the hold (nested defs get
+        # the same treatment via per-function call buckets)
+        self.deferred_calls: set[int] = set()
+        # (edge a->b) -> (file, line) first witness
+        self.edges: dict[tuple[str, str], int] = {}
+        self._scan_reentrancy()
+        for qual, info in index.functions.items():
+            self._scan_function(qual, info)
+
+    # ---------------------------------------------------------- helpers
+
+    def lock_key(self, expr: str, class_name: str | None) -> str:
+        head, _, tail = expr.partition(".")
+        rest = expr[len(head) + 1:]
+        if head in ("self", "cls") and class_name:
+            return f"{class_name}.{rest}" if rest else f"{class_name}.{tail}"
+        if "." in expr:
+            return expr
+        return f"{self.modstem}.{expr}"
+
+    def _scan_reentrancy(self):
+        for node in self.index.all_assigns:
+            if not isinstance(node.value, ast.Call):
+                continue
+            ctor = last_attr(call_name(node.value))
+            if ctor not in ("RLock", "Condition"):
+                continue
+            for tgt in node.targets:
+                name = dotted(tgt)
+                if name:
+                    # class context of the assignment
+                    cls = None
+                    qual = self.index.enclosing(node.lineno)
+                    fn = self.index.functions.get(qual)
+                    if fn is not None:
+                        cls = fn.class_name
+                    self.reentrant.add(self.lock_key(name, cls))
+
+    # ----------------------------------------------- per-function scan
+
+    def _scan_function(self, qual: str, info: FunctionInfo):
+        acquired: list[tuple[str, int]] = []
+        regions: list[tuple[str, int, int, int]] = []
+
+        own_release_lines: dict[str, list[int]] = {}
+        for node in self.index.calls_in(qual):
+            name = call_name(node)
+            if last_attr(name) == "release":
+                recv = name.rpartition(".")[0]
+                if recv and _lockish(last_attr(recv)):
+                    key = self.lock_key(recv, info.class_name)
+                    own_release_lines.setdefault(key, []).append(
+                        node.lineno
+                    )
+
+        handled: set[int] = set()
+
+        def acquire_key(call: ast.Call) -> str | None:
+            name = call_name(call)
+            if last_attr(name) != "acquire":
+                return None
+            recv = name.rpartition(".")[0]
+            if recv and _lockish(last_attr(recv)):
+                return self.lock_key(recv, info.class_name)
+            return None
+
+        def release_after(key: str, lineno: int) -> int:
+            for ln in sorted(own_release_lines.get(key, [])):
+                if ln >= lineno:
+                    return ln
+            return info.node.end_lineno or lineno
+
+        def record(key, lineno, start, end, held):
+            acquired.append((key, lineno))
+            regions.append((key, lineno, start, end))
+            for h, _ln in held:
+                self._edge(h, key, lineno)
+
+        def visit(node, held: list[tuple[str, int]]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue  # nested defs run later, not under this hold
+                if isinstance(child, ast.Lambda):
+                    # same rule as nested defs — and remember the call
+                    # nodes so the blocking pass can exempt them (a
+                    # lambda's calls land in the ENCLOSING function's
+                    # bucket, unlike a nested def's)
+                    self.deferred_calls.update(
+                        id(n) for n in ast.walk(child)
+                        if isinstance(n, ast.Call)
+                    )
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    new: list[tuple[str, int]] = []
+                    has_lock = False
+                    for item in child.items:
+                        expr = is_lock_expr(item.context_expr)
+                        if expr is None:
+                            continue
+                        has_lock = True
+                        key = self.lock_key(expr, info.class_name)
+                        record(
+                            key, child.lineno, child.lineno,
+                            child.end_lineno or child.lineno, held + new,
+                        )
+                        new.append((key, child.lineno))
+                    if has_lock:
+                        # exempt the acquisition expressions themselves
+                        # (e.g. a CM factory call) from DL002
+                        for item in child.items:
+                            for n in ast.walk(item.context_expr):
+                                if isinstance(n, ast.Call):
+                                    self.with_expr_calls.add(id(n))
+                    visit(child, held + new)
+                    continue
+                # flow-aware `if <acquire>` shapes (the try-lock idiom):
+                #   if X.acquire(...): <held in body only>
+                #   if not X.acquire(...): <return/raise>  -> held after
+                if isinstance(child, ast.If):
+                    test = child.test
+                    negated = False
+                    if isinstance(test, ast.UnaryOp) and isinstance(
+                        test.op, ast.Not
+                    ):
+                        test, negated = test.operand, True
+                    if isinstance(test, ast.NamedExpr):
+                        test = test.value
+                    key = (
+                        acquire_key(test)
+                        if isinstance(test, ast.Call) else None
+                    )
+                    if key is not None:
+                        handled.add(id(test))
+                        if negated:
+                            # held from after the guard to the release
+                            start = (child.end_lineno or child.lineno) + 1
+                            end = release_after(key, start)
+                            record(key, child.lineno, start, end, held)
+                            visit(child, held)
+                        else:
+                            body_end = max(
+                                (s.end_lineno or s.lineno
+                                 for s in child.body),
+                                default=child.lineno,
+                            )
+                            body_start = child.body[0].lineno
+                            record(
+                                key, child.lineno, body_start, body_end,
+                                held,
+                            )
+                            # body held; orelse not
+                            for stmt in child.body:
+                                visit(stmt, held + [(key, child.lineno)])
+                            for stmt in child.orelse:
+                                visit(stmt, held)
+                        continue
+                # explicit acquire(): held from here to the first
+                # matching release() below, else to end of function
+                if isinstance(child, ast.Call) and id(child) not in handled:
+                    key = acquire_key(child)
+                    if key is not None:
+                        end = release_after(key, child.lineno)
+                        record(
+                            key, child.lineno, child.lineno, end, held
+                        )
+                visit(child, held)
+
+        visit(info.node, [])
+        self.acquired[qual] = acquired
+        self.regions[qual] = regions
+
+    def _edge(self, a: str, b: str, lineno: int):
+        if a == b:
+            if a in self.reentrant:
+                return
+        self.edges.setdefault((a, b), lineno)
+
+
+def _analyze(sources):
+    out = []
+    for src in sources:
+        ml = getattr(src, "_dlint_locks", None)
+        if ml is None:
+            ml = _ModuleLocks(src, index_for(src))
+            src._dlint_locks = ml
+        out.append((src, index_for(src), ml))
+    return out
+
+
+def _call_edges(src, index, ml: _ModuleLocks, edges, witnesses):
+    """Edges held-lock -> locks acquired by same-module callees
+    (transitively, bounded depth): the PR-2 bug shape where the
+    blocking/acquiring code hides one call away."""
+    for qual, info in index.functions.items():
+        regions = ml.regions.get(qual, [])
+        if not regions:
+            continue
+        for node in index.calls_by_func.get(qual, ()):
+            name = call_name(node)
+            callee = None
+            head, _, tail = name.rpartition(".")
+            if head in ("self", "cls") and info.class_name:
+                q = f"{info.class_name}.{tail}"
+                if q in index.functions:
+                    callee = q
+            elif not head and name in index.functions:
+                callee = name
+            if callee is None:
+                continue
+            held = [
+                key for key, _wl, start, end in regions
+                if start <= node.lineno <= end
+            ]
+            if not held:
+                continue
+            for target in index.reachable({callee}, depth=_CALL_DEPTH):
+                for key, _ln in ml.acquired.get(target, []):
+                    for h in held:
+                        if h == key and key in ml.reentrant:
+                            continue
+                        e = (h, key)
+                        if e not in edges:
+                            edges[e] = (src.relpath, node.lineno)
+                            witnesses[e] = (
+                                f"{qual} -> {target}"
+                            )
+
+
+def check_lock_order(sources) -> list[Finding]:
+    analyzed = _analyze(sources)
+    # global edge graph: (a, b) -> (file, line); lock keys are
+    # Class.attr so the graph merges across modules
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    witnesses: dict[tuple[str, str], str] = {}
+    for src, index, ml in analyzed:
+        for (a, b), ln in ml.edges.items():
+            edges.setdefault((a, b), (src.relpath, ln))
+            witnesses.setdefault((a, b), "lexical nesting")
+        _call_edges(src, index, ml, edges, witnesses)
+
+    findings = []
+    seen_pairs = set()
+    for (a, b), (file, line) in sorted(edges.items()):
+        if a == b:
+            src = next(s for s, _i, _m in analyzed if s.relpath == file)
+            if src.allowed("lock-order", line):
+                continue
+            findings.append(Finding(
+                checker="lock-order", code="DL001", file=file, line=line,
+                message=(
+                    f"nested re-acquisition of non-reentrant lock {a} "
+                    f"(via {witnesses[(a, b)]}) — self-deadlock"
+                ),
+                detail=f"self|{a}",
+            ))
+            continue
+        if (b, a) not in edges or (b, a) in seen_pairs:
+            continue
+        seen_pairs.add((a, b))
+        rfile, rline = edges[(b, a)]
+        src = next(s for s, _i, _m in analyzed if s.relpath == file)
+        if src.allowed("lock-order", line):
+            continue
+        findings.append(Finding(
+            checker="lock-order", code="DL001", file=file, line=line,
+            message=(
+                f"inconsistent lock order: {a} -> {b} here "
+                f"({witnesses[(a, b)]}) but {b} -> {a} at "
+                f"{rfile}:{rline} ({witnesses[(b, a)]}) — potential "
+                f"deadlock cycle"
+            ),
+            detail=f"order|{min(a, b)}|{max(a, b)}",
+        ))
+    return findings
+
+
+def _blocking_label(call: ast.Call) -> str | None:
+    name = call_name(call)
+    if not name:
+        return None
+    if name in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[name]
+    tail = last_attr(name)
+    label = _BLOCKING_LAST.get(tail)
+    if label is not None:
+        # ".send"/".recv" on non-socket receivers (queues, generators)
+        # would be noise: require a socket-ish or bare receiver
+        if tail in ("send", "recv", "recv_into"):
+            recv = name.rpartition(".")[0].lower()
+            if recv and "sock" not in recv and recv not in ("self", "s"):
+                return None
+        return label
+    # any call on a *client* receiver is an RPC round-trip (the
+    # master_client / rpc client seam)
+    recv = name.rpartition(".")[0].lower()
+    if "client" in recv:
+        return "RPC round-trip"
+    # deletion callbacks (delete_func, _delete_step, ...): checkpoint
+    # step dirs are multi-GB — an rmtree under a lock serializes every
+    # other holder for the whole disk walk
+    if "delete" in tail.lower():
+        return "file deletion"
+    return None
+
+
+def check_blocking_under_lock(sources) -> list[Finding]:
+    findings = []
+    for src, index, ml in _analyze(sources):
+        for qual, regions in ml.regions.items():
+            if not regions:
+                continue
+            info = index.functions[qual]
+            # own bucket only (not calls_in): a nested def's body is
+            # deferred work with its own lock regions, matching the
+            # region builder's "nested defs run later" rule
+            for node in index.calls_by_func.get(qual, ()):
+                label = _blocking_label(node)
+                if label is None:
+                    continue
+                name = call_name(node)
+                if id(node) in ml.with_expr_calls:
+                    continue  # the acquisition expression itself
+                if id(node) in ml.deferred_calls:
+                    continue  # inside a lambda: runs after release
+                for key, with_line, start, end in regions:
+                    if not (start <= node.lineno <= end):
+                        continue
+                    if src.allowed(
+                        "blocking", node.lineno, with_line,
+                        info.node.lineno,
+                    ):
+                        continue
+                    findings.append(Finding(
+                        checker="blocking-under-lock", code="DL002",
+                        file=src.relpath, line=node.lineno,
+                        message=(
+                            f"{label} ({name}) while holding {key} "
+                            f"(acquired line {with_line}) — blocking "
+                            f"I/O under a lock stalls every other "
+                            f"holder"
+                        ),
+                        detail=f"{qual}|{key}|{name}",
+                    ))
+                    break  # one finding per call is enough
+    return findings

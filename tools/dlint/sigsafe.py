@@ -1,0 +1,178 @@
+"""DL004 signal-safety.
+
+Invariant: code reachable from a registered signal handler must be
+async-safe — PR 6's flight recorder self-deadlocked the dying process
+by logging from a SIGTERM handler that had interrupted the main thread
+inside a lock-holding telemetry hook.  CPython runs signal handlers
+between bytecodes *on the main thread*, so any non-reentrant lock the
+main thread can hold (the logging module's handler lock above all) is
+a self-deadlock when the handler tries to take it again.
+
+Forbidden within :data:`_HANDLER_DEPTH` call hops of a handler
+registered via ``signal.signal(...)``:
+
+- logging calls (``logger.*`` / ``logging.*``) and ``print``
+- unbounded lock acquisition: ``with <lock>`` or ``.acquire()``
+  without ``timeout=``/``blocking=False``
+- ``telemetry.snapshot`` (the PR-6 bug: use ``snapshot_best_effort``,
+  which bounds its lock acquire, from crash paths)
+- ``time.sleep`` (stretches the async window; a handler must finish)
+
+Guarded calls (e.g. logging behind an ``if not _quiet:`` that the
+signal path sets) carry ``# dlint: allow-signal(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dlint.astutil import (
+    call_name,
+    index_for,
+    last_attr,
+)
+from tools.dlint.core import Finding
+from tools.dlint.locks import is_lock_expr
+
+_HANDLER_DEPTH = 2
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "log",
+}
+
+
+def _handler_roots(src, index) -> dict[str, int]:
+    """qualname -> registration line for every function passed to
+    ``signal.signal`` in this module."""
+    roots: dict[str, int] = {}
+    for node in index.all_calls:
+        if call_name(node) != "signal.signal" or len(node.args) < 2:
+            continue
+        handler = node.args[1]
+        name = None
+        if isinstance(handler, ast.Name):
+            name = handler.id
+        elif isinstance(handler, ast.Attribute):
+            name = handler.attr
+        if not name or name in ("SIG_DFL", "SIG_IGN"):
+            continue
+        for qual, info in index.functions.items():
+            if info.name == name:
+                roots[qual] = node.lineno
+    return roots
+
+
+def _own_statements(node):
+    """Walk a function body excluding nested function definitions
+    (they are separate reachability nodes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _bounded_acquire(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    # positional: acquire(False) / acquire(True, timeout)
+    if call.args:
+        first = call.args[0]
+        if isinstance(first, ast.Constant) and first.value is False:
+            return True
+        if len(call.args) >= 2:
+            return True
+    return False
+
+
+def check_signal_safety(sources) -> list[Finding]:
+    findings = []
+    for src in sources:
+        index = index_for(src)
+        roots = _handler_roots(src, index)
+        if not roots:
+            continue
+        reachable = index.reachable(set(roots), depth=_HANDLER_DEPTH)
+        root_label = ", ".join(sorted(roots))
+        for qual in sorted(reachable):
+            info = index.functions.get(qual)
+            if info is None:
+                continue
+
+            def emit(lineno, kind, what):
+                if src.allowed("signal", lineno, info.node.lineno):
+                    return
+                findings.append(Finding(
+                    checker="signal-safety", code="DL004",
+                    file=src.relpath, line=lineno,
+                    message=(
+                        f"{kind} in {qual}, reachable from signal "
+                        f"handler ({root_label}) — handlers interrupt "
+                        f"the main thread mid-bytecode; {what}"
+                    ),
+                    detail=f"{qual}|{kind}",
+                ))
+
+            for node in _own_statements(info.node):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        if is_lock_expr(item.context_expr) is not None:
+                            emit(
+                                node.lineno,
+                                "unbounded lock acquire",
+                                "a lock the interrupted frame holds "
+                                "self-deadlocks the dying process",
+                            )
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if not name:
+                    continue
+                tail = last_attr(name)
+                recv = name.rpartition(".")[0]
+                if tail in _LOG_METHODS and (
+                    recv.endswith("logger") or recv == "logging"
+                    or recv.endswith(".logger")
+                ):
+                    emit(
+                        node.lineno, "logging call",
+                        "the logging module's handler lock is "
+                        "non-reentrant (write to a raw fd instead)",
+                    )
+                elif name == "print":
+                    emit(
+                        node.lineno, "print call",
+                        "stdout buffering takes non-reentrant locks "
+                        "(write to a raw fd instead)",
+                    )
+                elif tail == "snapshot" and "telemetry" in recv:
+                    emit(
+                        node.lineno, "telemetry.snapshot call",
+                        "use snapshot_best_effort: the plain snapshot "
+                        "blocks on the registry lock the interrupted "
+                        "frame may hold",
+                    )
+                elif tail == "sleep":
+                    emit(
+                        node.lineno, "sleep",
+                        "a handler must finish, not linger",
+                    )
+                elif tail == "acquire" and not _bounded_acquire(node):
+                    if _lockish_recv(recv):
+                        emit(
+                            node.lineno, "unbounded lock acquire",
+                            "pass timeout= or blocking=False from "
+                            "signal context",
+                        )
+    return findings
+
+
+def _lockish_recv(recv: str) -> bool:
+    return "lock" in last_attr(recv).lower() if recv else False
