@@ -239,6 +239,19 @@ def main():
     import gc
     import dataclasses as _dc
 
+    # latency-hiding scheduler flags for the "xla" overlap mode,
+    # appended BEFORE first backend use (XLA parses XLA_FLAGS lazily at
+    # backend init, never at import). Opt-in: flag availability depends
+    # on the XLA/libtpu build — this repo's CPU wheel rejects all three
+    # as unknown flags, fatally — so the operator asks for them
+    # explicitly on a build known to carry them.
+    if os.environ.get("DLROVER_TPU_LATENCY_HIDING") == "1":
+        from dlrover_tpu.parallel.overlap import latency_hiding_flags
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + latency_hiding_flags()
+        ).strip()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -299,59 +312,104 @@ def main():
         gc.collect()
         return dt, loss
 
-    # ---- headline: largest-fitting model, measured dtype selection ----
+    # ---- headline: largest-fitting model; measured PER-SITE dtype
+    # selection + measured overlap selection (every lever picked the
+    # way int8 always was: speed gated on loss parity, never
+    # hardcoded) ----
     rng = np.random.RandomState(0)
     h_tokens = jnp.asarray(
         rng.randint(0, headline_cfg.vocab_size, (h_batch, seq + 1))
     )
     t_bf16, loss_bf16 = run_arm(headline_cfg, strategy, h_tokens, steps)
-    int8_strategy = _dc.replace(strategy, compute_dtype="int8")
-
-    # the int8 run stays live: headline metrics + profile come from the
-    # selected arm
-    res = build(headline_cfg, int8_strategy)
-    state = res.state
-    state, m = res.train_step(state, {"tokens": h_tokens}, jax.random.key(0))
-    _ = float(m["loss"])
-    t0 = time.perf_counter()
-    for i in range(steps):
-        state, m = res.train_step(
-            state, {"tokens": h_tokens}, jax.random.key(i)
-        )
-    loss_int8 = float(m["loss"])
-    t_int8 = (time.perf_counter() - t0) / steps
 
     from dlrover_tpu.parallel.engine import LOSS_PARITY_TOL
 
+    def parity_pct(loss):
+        return abs(loss - loss_bf16) / max(abs(loss_bf16), 1e-9) * 100
+
+    # int8 per-site arms: everything / MLP einsums only / attention
+    # projections only — the per-site split the qdot/qeinsum site tags
+    # enable (ops/fp8.py quant_sites)
+    site_arms = {}
+    for sites in ("all", "mlp", "attn_qkv,attn_out"):
+        arm_strategy = _dc.replace(
+            strategy, compute_dtype="int8", quant_sites=sites
+        )
+        site_arms[sites] = run_arm(
+            headline_cfg, arm_strategy, h_tokens, steps
+        )
+    t_int8, loss_int8 = site_arms["all"]
     int8_vs_bf16_pct = (t_int8 / t_bf16 - 1.0) * 100
-    loss_parity_pct = abs(loss_int8 - loss_bf16) / max(
-        abs(loss_bf16), 1e-9
+    int8_mlp_vs_bf16_pct = (
+        site_arms["mlp"][0] / t_bf16 - 1.0
     ) * 100
-    # loss-parity gate: same tolerance the engine's _pick_best ships,
-    # so the published selection measures the product policy
-    int8_selected = (
-        t_int8 < t_bf16 and loss_parity_pct < LOSS_PARITY_TOL * 100
+    int8_attn_vs_bf16_pct = (
+        site_arms["attn_qkv,attn_out"][0] / t_bf16 - 1.0
+    ) * 100
+
+    # selection: fastest parity-passing candidate (bf16 always passes)
+    candidates = [("bfloat16", "all", t_bf16, loss_bf16)] + [
+        ("int8", sites, dt, loss)
+        for sites, (dt, loss) in site_arms.items()
+    ]
+    feasible = [
+        c for c in candidates
+        if parity_pct(c[3]) < LOSS_PARITY_TOL * 100
+    ]
+    selected_dtype, selected_sites, step_time, headline_loss = min(
+        feasible, key=lambda c: c[2]
     )
-    selected_dtype = "int8" if int8_selected else "bfloat16"
-    if int8_selected:
-        step_time, headline_loss = t_int8, loss_int8
-    else:
-        # parity failure or slower int8: the gate falls back to bf16
-        # and the bench still emits its JSON (the parity value is
-        # published for the judge either way)
-        step_time, headline_loss = t_bf16, loss_bf16
+    loss_parity_pct = (
+        parity_pct(headline_loss) if selected_dtype != "bfloat16"
+        else parity_pct(loss_int8)
+    )
+    sel_strategy = _dc.replace(
+        strategy, compute_dtype=selected_dtype,
+        quant_sites=selected_sites,
+    )
+
+    # overlap lever on top of the selected arm: the double-buffered
+    # per-layer fsdp gather schedule (parallel/overlap.py). On a
+    # fsdp=1 mesh the gather is a no-op and the trace is structurally
+    # identical to the plain one (layer_gather_fn bails out), so the
+    # arms would only publish run-to-run jitter — skip them and report
+    # the delta as None; on fsdp>1 meshes BOTH mechanisms are raced
+    # (GSPMD's native all-gather at the double-buffered position vs
+    # the decomposed ppermute ring) and the fastest parity-passing one
+    # is selected — "manual" winning is what arms the require-ops gate
+    # below.
+    headline_fsdp = sel_strategy.mesh.fsdp
+    overlap_step_delta_pct = None
+    if headline_fsdp > 1:
+        ovl_arms = {
+            mode: run_arm(
+                headline_cfg,
+                _dc.replace(sel_strategy, overlap_collectives=mode),
+                h_tokens, steps,
+            )
+            for mode in ("xla", "manual")
+        }
+        ovl_mode = min(ovl_arms, key=lambda k: ovl_arms[k][0])
+        t_ovl, loss_ovl = ovl_arms[ovl_mode]
+        overlap_step_delta_pct = (t_ovl / step_time - 1.0) * 100
+        overlap_selected = (
+            t_ovl < step_time
+            and parity_pct(loss_ovl) < LOSS_PARITY_TOL * 100
+        )
+        if overlap_selected:
+            sel_strategy = _dc.replace(
+                sel_strategy, overlap_collectives=ovl_mode
+            )
+            step_time, headline_loss = t_ovl, loss_ovl
     tokens_per_sec = h_batch * seq / step_time
 
-    if not int8_selected:
-        # the kernel profile below must describe the SELECTED arm
-        del res, state
-        gc.collect()
-        res = build(headline_cfg, strategy)
-        state = res.state
-        state, m = res.train_step(
-            state, {"tokens": h_tokens}, jax.random.key(0)
-        )
-        _ = float(m["loss"])
+    # the kernel profile below must describe the SELECTED arm
+    res = build(headline_cfg, sel_strategy)
+    state = res.state
+    state, m = res.train_step(
+        state, {"tokens": h_tokens}, jax.random.key(0)
+    )
+    _ = float(m["loss"])
 
     params = sum(x.size for x in jax.tree.leaves(state.params))
     model_flops = 6 * params * h_batch * seq + (
@@ -370,6 +428,10 @@ def main():
     # True/False only when an op list was actually checked
     remat_none_checkpoint_free = None
     remat_none_checkpoint_detail = ""
+    # same contract for the require-ops gate (decomposed-collective pin,
+    # armed only with manual overlap on a sharded mesh)
+    overlap_require_ops_ok = None
+    overlap_require_ops_detail = ""
     prof_dir = tempfile.mkdtemp(prefix="bench_prof_")
     try:
         from dlrover_tpu.agent.monitor import MetricsEndpoint
@@ -380,32 +442,77 @@ def main():
             ConfigPath.ENV_KERNEL_METRICS, ConfigPath.KERNEL_METRICS)
         if os.path.exists(kpath):
             os.unlink(kpath)  # a stale file must not fake the signal
+        # the PR-1 forbid-ops gate, ARMED on the headline arm: a
+        # remat=none step must profile checkpoint-free (the chunked CE
+        # is a custom_vjp now — no intentional jax.checkpoint remains
+        # anywhere in the headline trace). With manual overlapped
+        # collectives on a sharded mesh the require-ops gate also pins
+        # the decomposed collective-permute ring (XLA re-serializing it
+        # into one all-gather would silently undo the overlap).
+        forbid = (
+            ("checkpoint",) if sel_strategy.remat == "none" else ()
+        )
+        require = (
+            ("collective-permute",)
+            if (sel_strategy.overlap_collectives == "manual"
+                and headline_fsdp > 1)
+            else ()
+        )
         prof = StepProfiler(prof_dir, start_step=0, num_steps=2,
-                            publish_top_ops=True)
+                            publish_top_ops=True, forbid_ops=forbid,
+                            require_ops=require)
+        forbid_error = None
         for i in range(2):
             prof.maybe_start(i)
             state, m = res.train_step(
                 state, {"tokens": h_tokens}, jax.random.key(500 + i))
-            prof.maybe_stop(i, block_on=m["loss"])
-        # profiler-hook gate: a remat=none step must profile free of
-        # checkpoint calls (a leak here charged 25.7 ms/step before the
-        # quant-aware gate). The fused CE keeps ONE intentional
-        # jax.checkpoint when ce_chunks>1 (a logits-memory feature, not
-        # remat policy), so the hook's verdict — including any
-        # surviving op list — is published in the JSON rather than
-        # aborting the bench on the known call.
-        if strategy.remat == "none":
             try:
-                n_ops = prof.assert_ops_absent(("checkpoint",))
-                if n_ops:
-                    remat_none_checkpoint_free = True
+                prof.maybe_stop(i, block_on=m["loss"])
+            except AssertionError as err:
+                # gate verdicts are published in the JSON rather than
+                # aborting the bench mid-emit; only the forbid failure
+                # is recorded here (it fires first inside maybe_stop) —
+                # the require gate gets its own explicit check below so
+                # each failure lands under its own verdict key. HEAD
+                # truncation: the "forbidden ops"/"required ops" marker
+                # that classifies the failure is at the start, the op
+                # list tail is the expendable part
+                forbid_error = str(err)[:240]
+        if sel_strategy.remat == "none":
+            if forbid_error is not None and "forbidden ops" in forbid_error:
+                remat_none_checkpoint_free = False
+                remat_none_checkpoint_detail = forbid_error
+            else:
+                try:
+                    n_ops = prof.assert_ops_absent(("checkpoint",))
+                except AssertionError as err:
+                    # reachable when maybe_stop died before its gates
+                    # ran (e.g. stats publish threw): still a verdict,
+                    # never an abort before the JSON emits
+                    remat_none_checkpoint_free = False
+                    remat_none_checkpoint_detail = str(err)[:240]
                 else:
-                    remat_none_checkpoint_detail = (
+                    if n_ops:
+                        remat_none_checkpoint_free = True
+                    else:
+                        remat_none_checkpoint_detail = (
+                            "no profiled ops available to inspect"
+                        )
+        if require:
+            # checked directly against the finished window: a forbid
+            # failure in maybe_stop pre-empts its require check, and a
+            # require failure must never masquerade as a checkpoint leak
+            try:
+                n_ops = prof.assert_ops_present(require)
+                if n_ops:
+                    overlap_require_ops_ok = True
+                else:
+                    overlap_require_ops_detail = (
                         "no profiled ops available to inspect"
                     )
             except AssertionError as err:
-                remat_none_checkpoint_free = False
-                remat_none_checkpoint_detail = str(err)[-240:]
+                overlap_require_ops_ok = False
+                overlap_require_ops_detail = str(err)[:240]
         endpoint = MetricsEndpoint(exporter=None, host="127.0.0.1")
         port = endpoint.start()
         try:
@@ -426,6 +533,72 @@ def main():
         pass
     finally:
         shutil.rmtree(prof_dir, ignore_errors=True)
+
+    # ---- optimizer-step attribution: the update timed SEPARATELY
+    # from fwd/bwd (opt_step_ms = the headline arm's real optimizer on
+    # the headline param tree), plus the fused one-pass lever measured
+    # against the per-leaf 8-bit Adam kernel chain on a many-leaf tree
+    # (the dispatch-tail scenario the fusion exists for; headline-sized
+    # 8-bit state would also need the f32 moment transients in HBM, so
+    # the lever is attributed at a size that isolates dispatch
+    # overhead, not HBM pressure) ----
+    opt_keys = {}
+    try:
+        from dlrover_tpu.ops.fused_optim import (
+            fused_adamw,
+            pallas_call_count,
+        )
+        from dlrover_tpu.optimizers import adam8bit
+
+        def time_opt(opt, tree, nsteps):
+            st = jax.jit(opt.init)(tree)
+            upd_fn = jax.jit(opt.update)
+            u, st = upd_fn(tree, st, tree)  # grads stand-in: same tree
+            jax.block_until_ready(jax.tree.leaves(u)[0])
+            t0 = time.perf_counter()
+            for _ in range(nsteps):
+                u, st = upd_fn(tree, st, tree)
+            jax.block_until_ready(jax.tree.leaves(u)[0])
+            return (time.perf_counter() - t0) / nsteps
+
+        o_steps = 5 if on_tpu else 2
+        opt_keys["opt_step_ms"] = round(
+            time_opt(optax.adafactor(1e-3), state.params, o_steps)
+            * 1e3, 3,
+        )
+        n_leaves = 64 if on_tpu else 8
+        leaf_elems = (1 << 22) if on_tpu else (1 << 10)
+        many = {
+            f"w{i}": jnp.full((leaf_elems,), 0.01 * (i + 1), jnp.float32)
+            for i in range(n_leaves)
+        }
+        fused8 = fused_adamw(1e-3, bits=8)
+        perleaf8 = adam8bit(1e-3)
+        t_fused = time_opt(fused8, many, o_steps)
+        t_perleaf = time_opt(perleaf8, many, o_steps)
+        opt_keys.update({
+            "opt_fused_step_ms": round(t_fused * 1e3, 3),
+            "opt_adam8bit_step_ms": round(t_perleaf * 1e3, 3),
+            "opt_fused_vs_perleaf_pct": round(
+                (t_fused / t_perleaf - 1.0) * 100, 2
+            ),
+            # the bounded-dispatch gate: one pallas_call regardless of
+            # leaf count vs the per-leaf kernel chain
+            "opt_fused_dispatches": pallas_call_count(
+                lambda g, s, p: fused8.update(g, s, p),
+                many, fused8.init(many), many,
+            ),
+            "opt_adam8bit_dispatches": pallas_call_count(
+                lambda g, s, p: perleaf8.update(g, s, p),
+                many, perleaf8.init(many), many,
+            ),
+            "opt_attrib_leaves_elems": f"{n_leaves}x{leaf_elems}",
+            "fused_optim_selected": bool(t_fused < t_perleaf),
+        })
+        del many
+        gc.collect()
+    except Exception as e:  # noqa: BLE001 - attribution is best-effort
+        opt_keys["opt_bench_error"] = f"{type(e).__name__}: {e}"[:120]
 
     # free the headline model before the checkpoint-section compile
     del res, state, m
@@ -723,11 +896,31 @@ def main():
             # selected (its dots run the 2x int8 MXU path)
             "mfu_pct": round(mfu * 100, 2),
             # measured dtype selection on the HEADLINE model, gated on
-            # loss parity (engine.py StrategySearchEngine._pick_best)
+            # loss parity (engine.py StrategySearchEngine._pick_best) —
+            # now PER-SITE: "all" / "mlp" / "attn_qkv,attn_out" arms
+            # race and the fastest parity-passing one wins
             "selected_compute_dtype": selected_dtype,
+            "selected_quant_sites": selected_sites,
             "int8_vs_bf16_step_pct": round(int8_vs_bf16_pct, 2),
+            "int8_mlp_vs_bf16_step_pct": round(int8_mlp_vs_bf16_pct, 2),
+            # the attention-projection lever in isolation: QKV/out
+            # einsums int8, MLP bf16, vs the all-bf16 step
+            "int8_attn_vs_bf16_step_pct": round(
+                int8_attn_vs_bf16_pct, 2
+            ),
             "int8_loss_parity_pct": round(loss_parity_pct, 3),
+            # collective-overlap lever: selected arm with the
+            # double-buffered per-layer fsdp gather scan, on vs off.
+            # null = arm skipped because the headline mesh is fsdp=1
+            # (the gather is a no-op there — the win needs a sharded
+            # mesh, see MULTICHIP arms)
+            "overlap_step_delta_pct": (
+                round(overlap_step_delta_pct, 2)
+                if overlap_step_delta_pct is not None else None
+            ),
+            "overlap_mode_selected": sel_strategy.overlap_collectives,
             "headline_loss": round(headline_loss, 4),
+            **opt_keys,
             "ckpt_blocking_pause_s": round(ckpt_pause, 4),
             "ckpt_state_model": "nano-350m (pause is dispatch-side and "
                                 "size-independent; link-bound legs at "
@@ -798,6 +991,13 @@ def main():
             # null = gate not run (remat!=none, or no profiled ops)
             "remat_none_checkpoint_free": remat_none_checkpoint_free,
             "remat_none_checkpoint_detail": remat_none_checkpoint_detail,
+            # require-ops gate (manual overlap only): True = the
+            # decomposed collective-permute ring survived into the
+            # profiled window; False = XLA re-serialized it (_detail
+            # has the missing ops); null = gate not armed (overlap !=
+            # manual or fsdp=1) or no profiled ops to inspect
+            "overlap_require_ops_ok": overlap_require_ops_ok,
+            "overlap_require_ops_detail": overlap_require_ops_detail,
             **sparse,
             **control_plane,
             "backend": jax.default_backend(),

@@ -165,14 +165,17 @@ def _block(config: GPT2Config, x, p):
         # trick as llama's _layer; gate + dispatch shared via llama)
         w4 = p["w_qkv"].astype(dtype).reshape(D, 3, h, hd)
         b4 = p["b_qkv"].astype(dtype).reshape(3, 1, h, 1, hd)
-        qkv4 = qeinsum("bsd,dthk->tbhsk", y, w4) + b4
+        qkv4 = qeinsum("bsd,dthk->tbhsk", y, w4,
+                       site="attn_qkv") + b4
         out = bhsd_flash_attention(config, qkv4[0], qkv4[1], qkv4[2])
         attn_out = qeinsum(
             "bhsk,hkd->bsd", out,
-            p["w_proj"].astype(dtype).reshape(h, hd, D))
+            p["w_proj"].astype(dtype).reshape(h, hd, D),
+            site="attn_out")
         x = x + attn_out + p["b_proj"].astype(dtype)
     else:
-        qkv = qdot(y, p["w_qkv"].astype(dtype)) + p["b_qkv"].astype(dtype)
+        qkv = qdot(y, p["w_qkv"].astype(dtype), site="attn_qkv") \
+            + p["b_qkv"].astype(dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, S, h, hd)
         k = k.reshape(B, S, h, hd)
@@ -180,16 +183,17 @@ def _block(config: GPT2Config, x, p):
         # shared attention dispatcher (llama family): flash Pallas
         # kernel, reference softmax, or ring/Ulysses under a seq axis
         attn = _attention(config, q, k, v).reshape(B, S, D)
-        x = x + qdot(attn, p["w_proj"].astype(dtype)) \
-            + p["b_proj"].astype(dtype)
+        x = x + qdot(attn, p["w_proj"].astype(dtype),
+                     site="attn_out") + p["b_proj"].astype(dtype)
     x = shard_logical(x, ("batch", "seq", "embed"))
 
     y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], config.norm_eps)
     hmid = jax.nn.gelu(
-        qdot(y, p["w_fc"].astype(dtype)) + p["b_fc"].astype(dtype)
+        qdot(y, p["w_fc"].astype(dtype), site="mlp")
+        + p["b_fc"].astype(dtype)
     )
     hmid = shard_logical(hmid, ("batch", "seq", "mlp"))
-    x = x + qdot(hmid, p["w_out"].astype(dtype)) \
+    x = x + qdot(hmid, p["w_out"].astype(dtype), site="mlp") \
         + p["b_out"].astype(dtype)
     return shard_logical(x, ("batch", "seq", "embed"))
 
@@ -222,7 +226,16 @@ def _gpt2_stage_fn(config: GPT2Config):
     def layer_fn(h, lp):
         return _block(config, h, lp), jnp.zeros((), jnp.float32)
 
-    return stage_layer_scan(layer_fn, remat=config.remat)
+    # one layer's logical axes (sans the leading "layer" dim): opts the
+    # scan into the double-buffered fsdp-gather overlap when
+    # Strategy.overlap_collectives is active
+    layer_axes = {
+        k: tuple(v[1:])
+        for k, v in gpt2_logical_axes(config)["layers"].items()
+    }
+    return stage_layer_scan(
+        layer_fn, remat=config.remat, layer_axes=layer_axes
+    )
 
 
 def gpt2_apply(config: GPT2Config, params, tokens, positions=None):

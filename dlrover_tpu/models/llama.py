@@ -451,7 +451,7 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
             [p["wq"].astype(dtype).reshape(D, h, hd),
              p["wk"].astype(dtype).reshape(D, kvh, hd),
              p["wv"].astype(dtype).reshape(D, kvh, hd)], axis=1)
-        qkv = qeinsum("bsd,dhk->bhsk", y, w_qkv)
+        qkv = qeinsum("bsd,dhk->bhsk", y, w_qkv, site="attn_qkv")
         qt = qkv[:, :h]
         kt = qkv[:, h:h + kvh]
         vt = qkv[:, h + kvh:]
@@ -460,15 +460,19 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
         out = bhsd_flash_attention(
             config, qt, kt, vt, rope_cos=rope_cos, rope_sin=rope_sin)
         x = x + qeinsum("bhsk,hkd->bsd", out,
-                        p["wo"].astype(dtype).reshape(h, hd, D))
+                        p["wo"].astype(dtype).reshape(h, hd, D),
+                        site="attn_out")
     else:
-        q = qdot(y, p["wq"].astype(dtype)).reshape(B, S, h, hd)
-        k = qdot(y, p["wk"].astype(dtype)).reshape(B, S, kvh, hd)
-        v = qdot(y, p["wv"].astype(dtype)).reshape(B, S, kvh, hd)
+        q = qdot(y, p["wq"].astype(dtype), site="attn_qkv") \
+            .reshape(B, S, h, hd)
+        k = qdot(y, p["wk"].astype(dtype), site="attn_qkv") \
+            .reshape(B, S, kvh, hd)
+        v = qdot(y, p["wv"].astype(dtype), site="attn_qkv") \
+            .reshape(B, S, kvh, hd)
         q = _rope_apply(q, rope_cos, rope_sin)
         k = _rope_apply(k, rope_cos, rope_sin)
         attn = _attention(config, q, k, v).reshape(B, S, h * hd)
-        x = x + qdot(attn, p["wo"].astype(dtype))
+        x = x + qdot(attn, p["wo"].astype(dtype), site="attn_out")
     x = shard_logical(x, ("batch", "seq", "embed"))
 
     y = _rms_norm(x, p["mlp_norm"], config.norm_eps)
@@ -488,8 +492,9 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
             # one e4m3 scale and crush whichever operand is smaller —
             # keep independent matmuls there (int8 scales per output
             # channel, unaffected by the concat)
-            gate = jax.nn.silu(qdot(y, p["w_gate"].astype(dtype)))
-            up = qdot(y, p["w_up"].astype(dtype))
+            gate = jax.nn.silu(qdot(y, p["w_gate"].astype(dtype),
+                                    site="mlp"))
+            up = qdot(y, p["w_up"].astype(dtype), site="mlp")
             mlp = gate * up
         else:
             # gate/up as one stacked matmul (same residual-dedup
@@ -498,10 +503,10 @@ def _layer(config: LlamaConfig, x, layer_params, rope_cos, rope_sin):
             w_gu = jnp.concatenate(
                 [p["w_gate"].astype(dtype), p["w_up"].astype(dtype)],
                 axis=-1)
-            gu = qdot(y, w_gu)
+            gu = qdot(y, w_gu, site="mlp")
             mlp = jax.nn.silu(gu[..., :m]) * gu[..., m:]
         mlp = shard_logical(mlp, ("batch", "seq", "mlp"))
-        x = x + qdot(mlp, p["w_down"].astype(dtype))
+        x = x + qdot(mlp, p["w_down"].astype(dtype), site="mlp")
         aux = jnp.zeros((), jnp.float32)
     return shard_logical(x, ("batch", "seq", "embed")), aux
 
@@ -540,10 +545,18 @@ def _stage_fn(config: LlamaConfig):
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         "dots": jax.checkpoint_policies.dots_saveable,
     }[config.remat_policy]
+    # one layer's logical axes (stacked tree minus the leading "layer"
+    # dim): lets the scan double-buffer the per-layer fsdp gathers when
+    # Strategy.overlap_collectives is active (parallel/overlap.py)
+    layer_axes = {
+        k: tuple(v[1:])
+        for k, v in llama_logical_axes(config)["layers"].items()
+    }
     return stage_layer_scan(
         lambda h, lp, cos, sin: _layer(config, h, lp, cos, sin),
         remat=config.remat,
         policy=policy,
+        layer_axes=layer_axes,
     )
 
 
@@ -668,12 +681,15 @@ def llama_loss_fn(config: LlamaConfig):
                 return_hidden=True,
             )
             dtype = jnp.dtype(config.dtype)
+            # norm_scale path: the final RMSNorm fuses into the chunked
+            # custom-VJP CE — no jax.checkpoint, so a remat="none" step
+            # carries no checkpoint custom-call (the old norm_fn closure
+            # form kept one at ~25.7 ms/step, BENCH_r05 checkpoint.10)
             loss_sum, valid_sum = fused_linear_cross_entropy(
                 h, params["lm_head"].astype(dtype), labels,
                 n_chunks=config.ce_chunks,
-                norm_fn=lambda t: _rms_norm(
-                    t, params["final_norm"], config.norm_eps
-                ),
+                norm_scale=params["final_norm"],
+                norm_eps=config.norm_eps,
             )
             return loss_sum / jnp.maximum(valid_sum, 1) + aux
         logits, aux = llama_apply(

@@ -21,3 +21,11 @@ from dlrover_tpu.ops.quantization import (  # noqa: F401
     quantize_int8,
     dequantize_int8,
 )
+from dlrover_tpu.ops.collectives import (  # noqa: F401
+    ring_all_gather,
+    ring_reduce_scatter,
+)
+from dlrover_tpu.ops.fused_optim import (  # noqa: F401
+    fused_adamw,
+    pallas_call_count,
+)

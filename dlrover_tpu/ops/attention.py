@@ -217,8 +217,14 @@ def _unrope_tile(g, cos_ref, sin_ref):
 
 
 def _compiler_params(dims):
+    # jax >= 0.8 spells it CompilerParams; 0.4.x TPUCompilerParams
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is None:
+        return None
     try:
-        return pltpu.CompilerParams(dimension_semantics=dims)
+        return cls(dimension_semantics=dims)
     except TypeError:  # older/newer field name differences
         return None
 

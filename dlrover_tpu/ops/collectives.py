@@ -1,0 +1,101 @@
+"""Decomposed (ring) collectives for collective–compute overlap.
+
+Equivalent capability: the ZeRO/FSDP line of work and Megatron-style
+overlapped schedules hide the per-layer param all-gather / grad
+reduce-scatter behind neighbouring layers' compute. XLA can only
+overlap what it can *schedule*: a monolithic ``all-gather`` is one op
+with one ready time, while a ring of ``collective-permute`` steps is
+N-1 independently schedulable ops that interleave with the layer's
+matmuls. These helpers are the manual decomposition — numerically
+identical to ``jax.lax.all_gather`` / ``jax.lax.psum_scatter`` (pinned
+by tests/test_hot_loop.py on a multi-device CPU mesh) but expressed as
+ppermute rings so the latency-hiding scheduler sees individual steps.
+
+They run inside ``shard_map`` bodies. The axis size is passed
+explicitly (``jax.lax.axis_size`` does not exist on every supported
+jax); callers take it from the mesh (``parallel.mesh.axis_size``).
+
+Autodiff: both are plain compositions of ``ppermute`` +
+``dynamic_slice``/``dynamic_update_slice``, so the transpose of the
+ring all-gather *is* a ring reduce-scatter (and vice versa) — the
+backward pass stays decomposed for free, which is exactly the grad
+reduce-scatter overlap the fsdp schedule needs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_all_gather", "ring_reduce_scatter"]
+
+
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_all_gather(x, axis_name: str, axis_size: int, dim: int = 0):
+    """All-gather ``x`` along ``axis_name`` as N-1 ppermute steps.
+
+    ``x`` is this device's shard with the gathered dim at ``dim``;
+    returns the full (tiled) array, identical on every member of the
+    axis — the decomposed equivalent of
+    ``jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)``.
+    """
+    n = int(axis_size)
+    if n == 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    size = x.shape[dim]
+    out_shape = x.shape[:dim] + (n * size,) + x.shape[dim + 1:]
+    out = jnp.zeros(out_shape, x.dtype)
+
+    def place(buf, chunk, src):
+        starts = [jnp.int32(0)] * buf.ndim
+        starts[dim] = (src * size).astype(jnp.int32)
+        return jax.lax.dynamic_update_slice(buf, chunk, tuple(starts))
+
+    cur = x
+    out = place(out, cur, idx)
+    perm = _ring_perm(n)
+    for t in range(1, n):
+        cur = jax.lax.ppermute(cur, axis_name, perm)
+        out = place(out, cur, (idx - t) % n)
+    return out
+
+
+def ring_reduce_scatter(x, axis_name: str, axis_size: int, dim: int = 0):
+    """Reduce-scatter (sum) ``x`` along ``axis_name`` as N-1 ppermute
+    steps.
+
+    Every device holds a full-length ``x`` (its partial sum); device
+    ``i`` receives the total of tile ``i`` — the decomposed equivalent
+    of ``jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
+    tiled=True)``. The partial destined for device ``d`` starts one hop
+    ahead at ``d+1`` and walks the full ring, accumulating each visited
+    device's tile ``d``, arriving home after N-1 hops.
+    """
+    n = int(axis_size)
+    if n == 1:
+        return x
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"dim {dim} of shape {x.shape} not divisible by "
+            f"axis size {n}"
+        )
+    idx = jax.lax.axis_index(axis_name)
+    chunk = x.shape[dim] // n
+
+    def take(pos):
+        starts = [jnp.int32(0)] * x.ndim
+        starts[dim] = (pos * chunk).astype(jnp.int32)
+        sizes = list(x.shape)
+        sizes[dim] = chunk
+        return jax.lax.dynamic_slice(x, tuple(starts), tuple(sizes))
+
+    perm = _ring_perm(n)
+    acc = take((idx - 1) % n)
+    for t in range(1, n):
+        acc = jax.lax.ppermute(acc, axis_name, perm)
+        acc = acc + take((idx - 1 - t) % n)
+    return acc

@@ -12,8 +12,11 @@ the full logits row never materialises on one device.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def softmax_cross_entropy(logits, labels, ignore_index: int = -100):
@@ -70,26 +73,141 @@ def vocab_parallel_cross_entropy(
     return loss, valid
 
 
+def _rms(x, scale, eps):
+    """RMSNorm, expression-identical to models/llama.py _rms_norm (the
+    chunked CE recomputes the model's final norm chunk by chunk)."""
+    var = jnp.mean(
+        jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True
+    )
+    normed = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
+    return normed * scale.astype(x.dtype)
+
+
+def _ce_chunks(h, labels, n: int):
+    B, S, D = h.shape
+    hc = h.reshape(B, n, S // n, D).transpose(1, 0, 2, 3)
+    lc = labels.reshape(B, n, S // n).transpose(1, 0, 2)
+    return hc, lc
+
+
+def _chunk_loss_fn(cfg):
+    n, ignore_index, eps, use_norm = cfg
+
+    def chunk_loss(h_c, w, norm_scale, lab_c):
+        x = _rms(h_c, norm_scale, eps) if use_norm else h_c
+        logits = (x @ w).astype(jnp.float32)
+        loss, _valid = softmax_cross_entropy(
+            logits, lab_c, ignore_index=ignore_index
+        )
+        return loss.sum()
+
+    return chunk_loss
+
+
+def _chunked_ce_fwd_scan(cfg, h, w, norm_scale, labels):
+    n, ignore_index, eps, use_norm = cfg
+    hc, lc = _ce_chunks(h, labels, n)
+
+    def body(carry, inp):
+        h_c, lab_c = inp
+        x = _rms(h_c, norm_scale, eps) if use_norm else h_c
+        logits = (x @ w).astype(jnp.float32)
+        loss, valid = softmax_cross_entropy(
+            logits, lab_c, ignore_index=ignore_index
+        )
+        ls, vs = carry
+        return (ls + loss.sum(), vs + valid.sum()), None
+
+    (loss_sum, valid_sum), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (hc, lc),
+    )
+    return loss_sum, valid_sum
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _chunked_ce(cfg, h, w, norm_scale, labels):
+    return _chunked_ce_fwd_scan(cfg, h, w, norm_scale, labels)
+
+
+def _chunked_ce_fwd(cfg, h, w, norm_scale, labels):
+    out = _chunked_ce_fwd_scan(cfg, h, w, norm_scale, labels)
+    # residuals are the INPUTS only — exactly what the old
+    # nothing-saveable jax.checkpoint kept, minus its custom-call
+    return out, (h, w, norm_scale, labels)
+
+
+def _chunked_ce_bwd(cfg, res, cts):
+    n, _ignore_index, _eps, _use_norm = cfg
+    h, w, norm_scale, labels = res
+    g_loss, _g_valid = cts  # valid_sum is integer: float0 cotangent
+    hc, lc = _ce_chunks(h, labels, n)
+    grad_fn = jax.grad(_chunk_loss_fn(cfg), argnums=(0, 1, 2))
+
+    def body(carry, inp):
+        dw_acc, dns_acc = carry
+        h_c, lab_c = inp
+        # recompute this chunk's logits and differentiate just it: one
+        # [B, S/n, V] logits buffer lives at a time, same peak memory
+        # as the forward
+        dh_c, dw_c, dns_c = grad_fn(h_c, w, norm_scale, lab_c)
+        return (dw_acc + dw_c, dns_acc + dns_c), dh_c
+
+    (dw, dns), dh_chunks = jax.lax.scan(
+        body,
+        (jnp.zeros_like(w), jnp.zeros_like(norm_scale)),
+        (hc, lc),
+    )
+    dh = dh_chunks.transpose(1, 0, 2, 3).reshape(h.shape)
+    g = g_loss.astype(jnp.float32)
+    # integer input: cotangent must be float0 (custom_vjp contract)
+    dlabels = np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return (
+        (dh * g.astype(dh.dtype)),
+        (dw * g.astype(dw.dtype)),
+        (dns * g.astype(dns.dtype)),
+        dlabels,
+    )
+
+
+_chunked_ce.defvjp(_chunked_ce_fwd, _chunked_ce_bwd)
+
+
 def fused_linear_cross_entropy(
     h, w, labels, n_chunks: int = 8, norm_fn=None,
-    ignore_index: int = -100,
+    ignore_index: int = -100, norm_scale=None, norm_eps: float = 1e-5,
 ):
-    """CE of ``softmax(norm_fn(h) @ w)`` without materialising the full
+    """CE of ``softmax(norm(h) @ w)`` without materialising the full
     [B, S, V] logits.
 
-    The sequence is processed in chunks under ``jax.checkpoint`` with a
-    nothing-saveable policy, so the forward holds one [B, S/n, V] logits
-    chunk at a time and the backward RECOMPUTES each chunk's logits
-    instead of storing them — peak logits memory drops by n_chunks at
-    the cost of one extra head matmul pass. At 32k vocab this is what
-    makes large per-device batches HBM-feasible (fp32 logits + their
-    cotangent otherwise cost ~8 bytes * B * S * V). Equivalent
-    capability: the reference gets this from fused CUDA CE losses.
+    The sequence is processed in chunks with a hand-written VJP: the
+    forward holds one [B, S/n, V] logits chunk at a time and the
+    backward RECOMPUTES each chunk's logits instead of storing them —
+    peak logits memory drops by n_chunks at the cost of one extra head
+    matmul pass. At 32k vocab this is what makes large per-device
+    batches HBM-feasible (fp32 logits + their cotangent otherwise cost
+    ~8 bytes * B * S * V). Equivalent capability: the reference gets
+    this from fused CUDA CE losses.
+
+    The recompute used to ride ``jax.checkpoint`` — whose lowering left
+    a ``checkpoint`` custom-call in the compiled step charged at
+    25.7 ms/step on the remat=none headline arm (BENCH_r05 top_ops
+    ``checkpoint.10``, #3 overall). The ``custom_vjp`` form expresses
+    the identical recompute schedule with zero remat machinery, so a
+    remat="none" step is now genuinely checkpoint-free (the bench's
+    StepProfiler forbid-ops gate pins it).
+
+    ``norm_scale``/``norm_eps``: fuse the model's final RMSNorm into
+    each chunk (the production path — models/llama.py). ``norm_fn``
+    (an arbitrary closure) is the legacy generic hook; it cannot ride
+    the custom VJP (closure tracers) and keeps the old
+    ``jax.checkpoint`` scan, checkpoint custom-call included.
 
     Returns ``(loss_sum, valid_count)`` over all tokens.
     """
-    import jax
-
+    if norm_fn is not None and norm_scale is not None:
+        raise ValueError("pass norm_fn OR norm_scale, not both")
     B, S, D = h.shape
     n = max(1, min(int(n_chunks), S))
     # pad to a chunk multiple rather than silently collapsing to n=1
@@ -105,25 +223,33 @@ def fused_linear_cross_entropy(
             axis=1,
         )
         S += pad
-    hc = h.reshape(B, n, S // n, D).transpose(1, 0, 2, 3)
-    lc = labels.reshape(B, n, S // n).transpose(1, 0, 2)
 
-    def body(carry, inp):
-        h_c, lab_c = inp
-        x = norm_fn(h_c) if norm_fn is not None else h_c
-        logits = (x @ w).astype(jnp.float32)
-        loss, valid = softmax_cross_entropy(
-            logits, lab_c, ignore_index=ignore_index
+    if norm_fn is not None:
+        hc, lc = _ce_chunks(h, labels, n)
+
+        def body(carry, inp):
+            h_c, lab_c = inp
+            logits = (norm_fn(h_c) @ w).astype(jnp.float32)
+            loss, valid = softmax_cross_entropy(
+                logits, lab_c, ignore_index=ignore_index
+            )
+            ls, vs = carry
+            return (ls + loss.sum(), vs + valid.sum()), None
+
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable
         )
-        ls, vs = carry
-        return (ls + loss.sum(), vs + valid.sum()), None
+        (loss_sum, valid_sum), _ = jax.lax.scan(
+            body,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            (hc, lc),
+        )
+        return loss_sum, valid_sum
 
-    body = jax.checkpoint(
-        body, policy=jax.checkpoint_policies.nothing_saveable
-    )
-    (loss_sum, valid_sum), _ = jax.lax.scan(
-        body,
-        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-        (hc, lc),
-    )
-    return loss_sum, valid_sum
+    use_norm = norm_scale is not None
+    if not use_norm:
+        # zero-size placeholder: the custom_vjp signature is fixed and
+        # the kernel never reads it when use_norm is False
+        norm_scale = jnp.zeros((0,), h.dtype)
+    cfg = (n, int(ignore_index), float(norm_eps), use_norm)
+    return _chunked_ce(cfg, h, w, norm_scale, labels)

@@ -37,11 +37,37 @@ E5M2_MAX = 57344.0
 
 class _Flag:
     mode: str | None = None  # None | "fp8" | "int8"
+    # which call sites quantize: None = all. Models tag their qdot/
+    # qeinsum calls with site labels ("attn_qkv", "attn_out", "mlp");
+    # per-site selection (Strategy.quant_sites) keeps e.g. the MLP
+    # einsums int8 while attention projections stay bf16 where the
+    # measured speed or loss parity fails site-wise.
+    sites: frozenset | None = None
 
 
 def quant_mode() -> str | None:
     """The active low-precision qdot mode (trace-time)."""
     return _Flag.mode
+
+
+def quant_sites() -> frozenset | None:
+    """The active site filter (None = every site quantizes)."""
+    return _Flag.sites
+
+
+def quant_site_enabled(site: str | None) -> bool:
+    """Whether a tagged call site quantizes under the active filter.
+
+    Untagged sites (``site=None``) always quantize — per-site opt-out
+    only exists for sites that declared a label."""
+    return _Flag.sites is None or site is None or site in _Flag.sites
+
+
+def parse_quant_sites(spec: str | None):
+    """``Strategy.quant_sites`` string -> site filter (None = all)."""
+    if spec is None or spec == "all" or spec == "":
+        return None
+    return frozenset(s.strip() for s in spec.split(",") if s.strip())
 
 
 def fp8_enabled() -> bool:
@@ -56,20 +82,30 @@ def fp8_enabled() -> bool:
 
 
 @contextlib.contextmanager
-def quant_autocast(mode: str = "fp8"):
+def quant_autocast(mode: str = "fp8", sites=None):
     """Trace-time switch: ``qdot`` quantizes while this is active.
 
     ``mode="int8"`` is the TPU-native path (v5e MXU has 2x int8
     throughput and no fp8 units); ``mode="fp8"`` rounds through
-    e4m3/e5m2 and only pays off on hardware with fp8 units."""
+    e4m3/e5m2 and only pays off on hardware with fp8 units.
+
+    ``sites``: optional iterable of site labels (or a
+    ``Strategy.quant_sites`` string) restricting quantization to the
+    tagged call sites; None = all sites (the historical behavior)."""
     if mode not in ("fp8", "int8"):
         raise ValueError(f"unknown quant mode {mode!r}")
-    prev = _Flag.mode
+    if isinstance(sites, str):
+        sites = parse_quant_sites(sites)
+    elif sites is not None:
+        sites = frozenset(sites)
+    prev, prev_sites = _Flag.mode, _Flag.sites
     _Flag.mode = mode
+    _Flag.sites = sites
     try:
         yield
     finally:
         _Flag.mode = prev
+        _Flag.sites = prev_sites
 
 
 class _RematFlag:
@@ -192,32 +228,34 @@ def _fp8_dot_bwd(res, g):
 fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
 
 
-def qeinsum(spec, a, b):
+def qeinsum(spec, a, b, site: str | None = None):
     """``jnp.einsum(spec, a, b)``, int8-quantized when
-    ``quant_autocast("int8")`` is active.
+    ``quant_autocast("int8")`` is active (and ``site`` passes the
+    per-site filter).
 
     This is the einsum-form projection hook: under int8 the models KEEP
     the einsum-form flash path (layout rides the quantized matmul, int32
     MXU accumulation). fp8 mode never reaches these call sites —
     ``flash_einsum_path`` yields to the qdot branch there (the emulated
     e4m3 round-trip has no einsum win to preserve)."""
-    if _Flag.mode == "int8":
+    if _Flag.mode == "int8" and quant_site_enabled(site):
         from dlrover_tpu.ops.quantization import int8_einsum
 
         return int8_einsum(spec, a, b)
     return jnp.einsum(spec, a, b)
 
 
-def qdot(a, b):
-    """``a @ b``, quantized when :func:`quant_autocast` is active.
+def qdot(a, b, site: str | None = None):
+    """``a @ b``, quantized when :func:`quant_autocast` is active (and
+    ``site`` passes the per-site filter).
 
     The flag is read at trace time, so wrapping the loss trace in the
     context (auto_accelerate does this for compute_dtype="fp8"/"int8")
     is enough — no per-call state threading. Only the linear-layer
     shape (2-D weight on the right) takes the quantized path; anything
     else falls through to the plain dot."""
-    if _Flag.mode is not None and getattr(b, "ndim", 0) == 2 and \
-            getattr(a, "ndim", 0) >= 2:
+    if _Flag.mode is not None and quant_site_enabled(site) and \
+            getattr(b, "ndim", 0) == 2 and getattr(a, "ndim", 0) >= 2:
         if _Flag.mode == "int8":
             from dlrover_tpu.ops.quantization import int8_dot
 

@@ -5,6 +5,11 @@ from dlrover_tpu.optimizers.wsam import (
     wsam_update,
 )
 from dlrover_tpu.optimizers.low_bit import adam8bit, scale_by_adam8bit
+from dlrover_tpu.ops.fused_optim import (
+    FusedAdam8bitState,
+    FusedAdamState,
+    fused_adamw,
+)
 from dlrover_tpu.optimizers.offload import OffloadAdam, OffloadAdamState
 from dlrover_tpu.optimizers.group_sparse import group_adagrad, group_adam
 from dlrover_tpu.optimizers.mup import (
@@ -22,6 +27,9 @@ __all__ = [
     "wsam_update",
     "adam8bit",
     "scale_by_adam8bit",
+    "fused_adamw",
+    "FusedAdamState",
+    "FusedAdam8bitState",
     "OffloadAdam",
     "OffloadAdamState",
     "group_adam",

@@ -122,9 +122,30 @@ def adam8bit(
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    fused: bool = False,
+    clip_norm: float | None = None,
 ) -> optax.GradientTransformation:
-    """8-bit AdamW (decoupled weight decay on top of quantized moments)."""
-    tx = [scale_by_adam8bit(b1=b1, b2=b2, eps=eps)]
+    """8-bit AdamW (decoupled weight decay on top of quantized moments).
+
+    ``fused=True`` (the ``Strategy.fused_optim`` lever) returns the
+    one-pass variant (ops/fused_optim.py): decode, clip, EMA, update
+    and re-encode of BOTH moments run in a single Pallas dispatch over
+    the flattened leaves instead of a per-leaf kernel chain — same
+    state semantics within the documented quantization tolerance.
+    ``clip_norm`` fuses optax.clip_by_global_norm into the same pass
+    (also honored unfused, as a chained transform).
+    """
+    if fused:
+        from dlrover_tpu.ops.fused_optim import fused_adamw
+
+        return fused_adamw(
+            learning_rate, b1=b1, b2=b2, eps=eps,
+            weight_decay=weight_decay, clip_norm=clip_norm, bits=8,
+        )
+    tx = []
+    if clip_norm is not None:
+        tx.append(optax.clip_by_global_norm(clip_norm))
+    tx.append(scale_by_adam8bit(b1=b1, b2=b2, eps=eps))
     if weight_decay:
         tx.append(optax.add_decayed_weights(weight_decay))
     tx.append(optax.scale_by_learning_rate(learning_rate))

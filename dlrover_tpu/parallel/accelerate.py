@@ -297,9 +297,13 @@ def auto_accelerate(
         import contextlib
 
         from dlrover_tpu.ops.fp8 import no_remat_autocast, quant_autocast
+        from dlrover_tpu.parallel.overlap import overlap_autocast
 
         cparams = _compute_cast(params, cast_dtype)
-        ctx = quant_autocast(quant) if quant else contextlib.nullcontext()
+        ctx = (
+            quant_autocast(quant, sites=strategy.quant_sites)
+            if quant else contextlib.nullcontext()
+        )
         # remat="none" means NONE: suppress the model's own per-layer
         # jax.checkpoint and the qdot residual name-tags at trace time —
         # otherwise a no-remat headline still pays a checkpoint
@@ -308,7 +312,16 @@ def auto_accelerate(
             no_remat_autocast() if strategy.remat == "none"
             else contextlib.nullcontext()
         )
-        with ctx, rctx:
+        # collective–compute overlap: the layer scan double-buffers the
+        # per-layer fsdp gathers while this trace flag is up. The
+        # EFFECTIVE rule table rides along so the gather plans agree
+        # with the actual leaf shardings under custom Strategy.rules
+        octx = (
+            overlap_autocast(strategy.overlap_collectives, rules=rules)
+            if getattr(strategy, "overlap_collectives", "off") != "off"
+            else contextlib.nullcontext()
+        )
+        with ctx, rctx, octx:
             if has_aux:
                 grad_fn = jax.value_and_grad(inner_loss, has_aux=True)
                 (loss, aux), grads = grad_fn(cparams, batch, rng)

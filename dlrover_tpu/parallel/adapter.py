@@ -319,12 +319,17 @@ def _normalized_layer(layer_fn):
     return fn
 
 
-def stacked_loss_fn(spec: StackedModule) -> Callable:
+def stacked_loss_fn(spec: StackedModule, layer_axes=None) -> Callable:
     """(params, batch, rng) -> loss, running the layer stack through
     the GPipe schedule whenever the ``pipe`` mesh axis is active (the
     automatic pipeline-stage derivation: partition boundary = the
     stacked layer axis, reference
-    pipeline_parallel_optimization.py:56)."""
+    pipeline_parallel_optimization.py:56).
+
+    ``layer_axes`` (one layer's logical-axis tree, no leading "layer"
+    dim — :func:`accelerate_module` derives it from the inferred axes)
+    opts the scan into the double-buffered fsdp-gather overlap when
+    ``Strategy.overlap_collectives`` is active."""
 
     def loss_fn(params, batch, rng):
         from dlrover_tpu.parallel.pipeline import (
@@ -334,7 +339,8 @@ def stacked_loss_fn(spec: StackedModule) -> Callable:
         )
 
         stage_fn = stage_layer_scan(
-            _normalized_layer(spec.layer_fn), remat=spec.remat_layers
+            _normalized_layer(spec.layer_fn), remat=spec.remat_layers,
+            layer_axes=layer_axes,
         )
         h = spec.embed_fn(params, batch)
         if pipe_size() > 1:
@@ -368,8 +374,19 @@ def accelerate_module(
 
     abstract = jax.eval_shape(spec.init_fn, jax.random.key(seed))
     axes = infer_logical_axes(abstract, vocab_size=vocab_size)
+    # one layer's axes for the overlapped scan: strip the leading
+    # "layer" entry the stacked leaves carry
+    layer_axes = None
+    if isinstance(axes, dict) and "layers" in axes:
+        layer_axes = jax.tree.map(
+            lambda t: tuple(t[1:]) if (
+                isinstance(t, tuple) and t and t[0] == "layer"
+            ) else t,
+            axes["layers"],
+            is_leaf=lambda x: isinstance(x, tuple) or x is None,
+        )
     return auto_accelerate(
-        stacked_loss_fn(spec),
+        stacked_loss_fn(spec, layer_axes=layer_axes),
         spec.init_fn,
         optimizer,
         axes,

@@ -24,6 +24,7 @@ and ZeRO sharding compose with pipelining without any model changes.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -36,6 +37,56 @@ from dlrover_tpu.parallel.mesh import get_mesh
 logger = get_logger(__name__)
 
 AXIS = "pipe"
+
+
+def _probe_barrier_ad() -> bool:
+    try:
+        jax.make_jaxpr(jax.grad(
+            lambda x: jax.lax.optimization_barrier(x).sum()
+        ))(jnp.ones((1,)))
+        return True
+    except NotImplementedError:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def _barrier_fn():
+    """``jax.lax.optimization_barrier`` — or, on jax builds whose
+    barrier has no differentiation rule (0.4.x), a custom_vjp identity
+    wrapper that barriers the primal and passes cotangents through.
+    The native rule is preferred when present: it also pins the
+    BACKWARD schedule, which the 1F1B memory bound relies on."""
+    if _probe_barrier_ad():
+        return jax.lax.optimization_barrier
+
+    @jax.custom_vjp
+    def barrier(xs):
+        return jax.lax.optimization_barrier(xs)
+
+    def fwd(xs):
+        return jax.lax.optimization_barrier(xs), None
+
+    def bwd(_res, cts):
+        return (cts,)
+
+    barrier.defvjp(fwd, bwd)
+    return barrier
+
+
+def _opt_barrier(xs):
+    return _barrier_fn()(xs)
+
+
+def partial_manual_supported() -> bool:
+    """Whether this jax can compile the pipe schedules' PARTIAL-manual
+    shard_map (manual over ``pipe``, other mesh axes automatic) when a
+    non-pipe axis has extent > 1. jax >= 0.8 can; pre-0.8's SPMD
+    partitioner fatally CHECK-fails on the manual-subgroup shardings
+    the mixed region produces (axis_index -> partition-id is rejected,
+    and in-region collectives trip hlo_sharding_util manual-subgroup
+    CHECKs), so callers on legacy builds must keep the non-pipe mesh
+    extent at 1 alongside an active pipe axis."""
+    return hasattr(jax, "shard_map")
 
 
 def pipe_size() -> int:
@@ -140,7 +191,7 @@ def pipeline_apply(
             # behind the previous tick's ppermute — see the matching
             # barrier in pipeline_loss_1f1b for why (XLA:CPU rendezvous
             # mispairing across scan iterations)
-            params_t, state = jax.lax.optimization_barrier(
+            params_t, state = _opt_barrier(
                 (params_local, state)
             )
             feed = jnp.clip(t, 0, M - 1)
@@ -334,7 +385,7 @@ def pipeline_loss_1f1b(
             # executes collectives in program order, so this only pins
             # down an ordering the hardware imposes anyway.
             (params_t, last_params_t), fwd_msg = (
-                jax.lax.optimization_barrier(
+                _opt_barrier(
                     ((params_local, last_params_), fwd_msg)
                 )
             )
@@ -447,7 +498,7 @@ def pipeline_loss_1f1b(
             # order on different devices — a rendezvous deadlock. The
             # barrier makes the cotangent permute depend on the
             # activation permute's completion.
-            d_c, fwd_msg = jax.lax.optimization_barrier((d_c, fwd_msg))
+            d_c, fwd_msg = _opt_barrier((d_c, fwd_msg))
             bwd_msg = jax.lax.ppermute(
                 d_c, AXIS, [(i, i - 1) for i in range(1, S)]
             )
@@ -709,7 +760,7 @@ def pipeline_loss_1f1b_interleaved(
             (fwd_msg, bwd_msg, inbuf, cotbuf, d_params, d_last, d_x,
              ce_acc, aux_acc) = carry
             (params_t, last_params_t), fwd_msg = (
-                jax.lax.optimization_barrier(
+                _opt_barrier(
                     ((params_local, last_params_), fwd_msg)
                 )
             )
@@ -814,7 +865,7 @@ def pipeline_loss_1f1b_interleaved(
                 out_chain, AXIS,
                 [(i, (i + 1) % S) for i in range(S)],
             )
-            d_c, fwd_msg = jax.lax.optimization_barrier((d_c, fwd_msg))
+            d_c, fwd_msg = _opt_barrier((d_c, fwd_msg))
             bwd_msg = jax.lax.ppermute(
                 d_c, AXIS, [(i, (i - 1) % S) for i in range(S)]
             )
@@ -1170,6 +1221,7 @@ def stage_layer_scan(
     layer_fn: Callable,
     remat: bool = True,
     policy=None,
+    layer_axes=None,
 ):
     """Build a ``stage_fn`` that scans ``layer_fn`` over this stage's
     local stacked layers (the in-stage analogue of the model's full-depth
@@ -1178,6 +1230,14 @@ def stage_layer_scan(
     ``layer_fn(h, one_layer_params, *extras) -> (h, aux)``. Whatever
     save policy applies (passed or default) is adapted to the int8
     quantized path via :func:`quant_aware_policy`.
+
+    ``layer_axes`` (a pytree matching ONE layer's params whose leaves
+    are logical-axis tuples) opts the scan into collective–compute
+    overlap when ``overlap_autocast`` is active: the fsdp param gather
+    for layer *k+1* is issued while layer *k* computes, double-buffered
+    through the scan carry (parallel/overlap.py). Without the axes the
+    scan cannot know which dims are fsdp-sharded and runs the plain
+    schedule.
     """
 
     def body(carry, layer_params, *extras):
@@ -1187,21 +1247,58 @@ def stage_layer_scan(
 
     def stage_fn(local_params, h, *extras):
         from dlrover_tpu.ops.fp8 import remat_disabled
+        from dlrover_tpu.parallel.overlap import layer_gather_fn
+
+        chosen_policy = quant_aware_policy(
+            policy
+            or jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+        # the strategy's remat="none" wins over the model config: a
+        # no-remat trace must emit no checkpoint at any layer
+        do_remat = remat and not remat_disabled()
+
+        gather = layer_gather_fn(layer_axes)
+        if gather is not None:
+            L = jax.tree.leaves(local_params)[0].shape[0]
+
+            def fetch(i):
+                sl = jax.tree.map(
+                    lambda p: jax.lax.dynamic_index_in_dim(
+                        p, i, 0, keepdims=False
+                    ),
+                    local_params,
+                )
+                return gather(sl)
+
+            def overlap_body(carry, i):
+                (h, aux_sum), p_cur = carry
+                # issue the NEXT layer's gather before this layer's
+                # compute: no data dependency between them, so the
+                # scheduler can overlap the collective with the matmuls
+                # (the last iteration re-fetches its own layer — the
+                # buffer is unused but keeps one compiled body)
+                p_next = fetch(jnp.minimum(i + 1, L - 1))
+                inner, _ = body((h, aux_sum), p_cur, *extras)
+                return (inner, p_next), None
+
+            if do_remat:
+                overlap_body = jax.checkpoint(
+                    overlap_body, policy=chosen_policy
+                )
+            carry0 = (
+                (h, jnp.zeros((), jnp.float32)),
+                fetch(jnp.int32(0)),
+            )
+            ((h, aux_sum), _), _ = jax.lax.scan(
+                overlap_body, carry0, jnp.arange(L, dtype=jnp.int32)
+            )
+            return h, aux_sum
 
         def scan_body(carry, layer_params):
             return body(carry, layer_params, *extras)
 
-        # the strategy's remat="none" wins over the model config: a
-        # no-remat trace must emit no checkpoint at any layer
-        if remat and not remat_disabled():
-            scan_body = jax.checkpoint(
-                scan_body,
-                policy=quant_aware_policy(
-                    policy
-                    or jax.checkpoint_policies
-                    .dots_with_no_batch_dims_saveable
-                ),
-            )
+        if do_remat:
+            scan_body = jax.checkpoint(scan_body, policy=chosen_policy)
         (h, aux_sum), _ = jax.lax.scan(
             scan_body, (h, jnp.zeros((), jnp.float32)), local_params
         )

@@ -46,6 +46,27 @@ class Strategy:
     grad_accum: int = 1
     # optional donation of params/opt-state buffers in the train step.
     donate: bool = True
+    # collective–compute overlap for the fsdp layer scan
+    # (parallel/overlap.py): "off" = plain scan; "xla" = double-buffered
+    # per-layer gathers through the scan carry, GSPMD collectives +
+    # latency-hiding scheduler; "manual" = same schedule with the
+    # gathers decomposed into ppermute rings (ops/collectives.py) the
+    # scheduler can interleave step-by-step. Like int8, the product
+    # default comes from measured selection (bench/engine), not from
+    # hardcoding "on".
+    overlap_collectives: str = "off"
+    # which qdot/qeinsum call sites quantize under compute_dtype=
+    # "int8"/"fp8": "all", or a comma-separated subset of the site
+    # labels models tag ("attn_qkv", "attn_out", "mlp"). Per-site
+    # selection lets the measured search keep e.g. the MLP einsums
+    # int8 while holding attention projections in bf16 where parity
+    # (or speed) fails site-wise.
+    quant_sites: str = "all"
+    # one-pass fused optimizer step (ops/fused_optim.py): consumed by
+    # the optimizer factories (optimizers.low_bit.adam8bit(fused=...),
+    # fused_adamw) — recorded here so a serialized strategy captures
+    # the whole measured selection.
+    fused_optim: bool = False
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
@@ -68,9 +89,16 @@ class Strategy:
             for a in AXIS_ORDER
             if getattr(self.mesh, a) != 1
         }
+        extras = ""
+        if self.overlap_collectives != "off":
+            extras += f", overlap={self.overlap_collectives}"
+        if self.quant_sites != "all":
+            extras += f", qsites={self.quant_sites}"
+        if self.fused_optim:
+            extras += ", fused_optim"
         return (
             f"Strategy(mesh={active or 'dp-only'}, dtype={self.compute_dtype},"
-            f" remat={self.remat}, accum={self.grad_accum})"
+            f" remat={self.remat}, accum={self.grad_accum}{extras})"
         )
 
 

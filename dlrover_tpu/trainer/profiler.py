@@ -56,7 +56,7 @@ class StepProfiler:
 
     def __init__(self, log_dir: str, start_step: int = 10,
                  num_steps: int = 3, publish_top_ops: bool = False,
-                 forbid_ops: tuple = ()):
+                 forbid_ops: tuple = (), require_ops: tuple = ()):
         self.log_dir = log_dir
         self.start_step = int(start_step)
         self.stop_step = int(start_step) + int(num_steps)
@@ -68,6 +68,12 @@ class StepProfiler:
         # a remat gate leaked. Checked in maybe_stop; raises
         # AssertionError listing the offenders.
         self.forbid_ops = tuple(forbid_ops)
+        # op-name substrings that MUST appear — e.g.
+        # ("collective-permute",) with manual overlapped collectives:
+        # XLA re-serializing the decomposed ring back into one
+        # all-gather would silently undo the overlap win. Checked in
+        # maybe_stop; raises AssertionError naming the missing ops.
+        self.require_ops = tuple(require_ops)
         self._active = False
         self._done = False
 
@@ -115,6 +121,8 @@ class StepProfiler:
                                exc_info=True)
         if self.forbid_ops:
             self.assert_ops_absent(self.forbid_ops)
+        if self.require_ops:
+            self.assert_ops_present(self.require_ops)
 
     def assert_ops_absent(self, substrings: tuple) -> int:
         """Raise AssertionError if any profiled HLO op name contains one
@@ -133,6 +141,29 @@ class StepProfiler:
             raise AssertionError(
                 f"forbidden ops in profile window {self.log_dir}: "
                 f"{[(o['op'], o['category']) for o in bad]}"
+            )
+        return len(ops)
+
+    def assert_ops_present(self, substrings: tuple) -> int:
+        """Raise AssertionError unless EVERY substring matches at least
+        one profiled HLO op name. Vacuously passes when the trace
+        yields no op stats (xprof unavailable — same contract as
+        :meth:`assert_ops_absent`); returns the number of ops
+        inspected. This is the decomposed-collective gate: with manual
+        overlap enabled the profiled window must contain the
+        collective-permute ring steps, or XLA re-serialized them."""
+        ops = top_ops_from_trace(self.log_dir, k=4096)
+        if not ops:
+            return 0
+        missing = [
+            s for s in substrings
+            if not any(s.lower() in o["op"].lower() for o in ops)
+        ]
+        if missing:
+            raise AssertionError(
+                f"required ops missing from profile window "
+                f"{self.log_dir}: {missing} "
+                f"({len(ops)} ops inspected)"
             )
         return len(ops)
 

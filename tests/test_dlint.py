@@ -442,6 +442,97 @@ class TestJitPurity:
                 return x
         """, "jit-purity") == []
 
+    def test_pallas_kernel_impurities_flagged(self, tmp_path):
+        found = lint_file(tmp_path, """
+            import time
+            import jax
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                print("inside", time.time())
+                jax.pure_callback(lambda v: v, x_ref[0], x_ref[0])
+                head = x_ref[0]
+                o_ref[:] = x_ref[:] * head.item()
+
+            def run(x):
+                return pl.pallas_call(
+                    kernel,
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                )(x)
+        """, "jit-purity")
+        labels = {f.message.split(" inside ")[0] for f in found}
+        assert any("print" in x for x in labels)
+        assert any("host clock read" in x for x in labels)
+        assert any("host callback" in x for x in labels)
+        assert ".item() host sync" in labels
+        assert all("pallas kernel" in f.message for f in found)
+
+    def test_pallas_kernel_bare_imported_callback_flagged(self, tmp_path):
+        # `from jax import pure_callback` then a bare call: same defect
+        # class as the dotted form, must not slip past the bare-name
+        # exemption (which only covers a generic local `callback(...)`)
+        found = lint_file(tmp_path, """
+            from jax import pure_callback
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                pure_callback(lambda v: v, x_ref[0], x_ref[0])
+                o_ref[:] = x_ref[:]
+
+            def run(x, out_shape):
+                return pl.pallas_call(kernel, out_shape=out_shape)(x)
+        """, "jit-purity")
+        assert len(found) == 1
+        assert "host callback (pure_callback)" in found[0].message
+
+    def test_pallas_kernel_bare_generic_callback_clean(self, tmp_path):
+        # a local helper that happens to be NAMED `callback` is not a
+        # host callback — only the unambiguous pure/io names are
+        # flagged without a dotted qualifier
+        assert lint_file(tmp_path, """
+            from jax.experimental import pallas as pl
+
+            def callback(v):
+                return v * 2.0
+
+            def kernel(x_ref, o_ref):
+                o_ref[:] = callback(x_ref[:])
+
+            def run(x, out_shape):
+                return pl.pallas_call(kernel, out_shape=out_shape)(x)
+        """, "jit-purity") == []
+
+    def test_pallas_kernel_via_partial_flagged(self, tmp_path):
+        found = lint_file(tmp_path, """
+            import functools
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref, *, scale):
+                print("bad")
+                o_ref[:] = x_ref[:] * scale
+
+            def run(x, out_shape):
+                return pl.pallas_call(
+                    functools.partial(kernel, scale=2.0),
+                    out_shape=out_shape,
+                )(x)
+        """, "jit-purity")
+        assert len(found) == 1
+        assert "pallas kernel" in found[0].message
+
+    def test_pallas_clean_kernel_and_debug_print_ok(self, tmp_path):
+        assert lint_file(tmp_path, """
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                pl.debug_print("row max {}", jnp.max(x_ref[:]))
+                o_ref[:] = x_ref[:] * 2.0
+
+            def run(x, out_shape):
+                return pl.pallas_call(kernel, out_shape=out_shape)(x)
+        """, "jit-purity") == []
+
 
 # ---------------------------------------------------------------- DL006
 

@@ -8,6 +8,8 @@ import sys
 
 import pytest
 
+import jax
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -69,6 +71,11 @@ def test_example_runs(args, tmp_path):
         _cleanup_job_shm(job)
 
 
+@pytest.mark.skipif(
+    not hasattr(jax.config, "jax_num_cpu_devices"),
+    reason="this jax predates jax_num_cpu_devices: the multi-slice "
+    "workers cannot shape their per-process CPU device count",
+)
 def test_multi_slice_example_runs(tmp_path):
     """multi_slice_dp spawns its own jax.distributed processes (one per
     simulated slice), so it runs directly rather than through tpu-run;

@@ -9,6 +9,9 @@ import numpy as np
 import optax
 import pytest
 
+from tests.conftest import requires_partial_manual
+
+
 from dlrover_tpu.models import llama_init, llama_loss_fn
 from dlrover_tpu.models.llama import LlamaConfig, llama_logical_axes
 from dlrover_tpu.ops.fp8 import (
@@ -171,6 +174,7 @@ class TestEndToEndNumerics:
             losses.append(float(m["loss"]))
         return losses
 
+    @requires_partial_manual
     def test_fp8_composes_with_1f1b_pipeline(self):
         """compute_dtype='fp8' and pipe_schedule='1f1b' together: the
         autocast flag is up while the fused schedule traces, so the

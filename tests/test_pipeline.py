@@ -31,6 +31,8 @@ from dlrover_tpu.parallel.pipeline import (
     stage_layer_scan,
 )
 
+from tests.conftest import requires_partial_manual
+
 
 @pytest.fixture(autouse=True)
 def _reset_mesh():
@@ -49,6 +51,7 @@ def _elementwise_stage():
     return stage_layer_scan(layer_fn, remat=False)
 
 
+@requires_partial_manual
 def test_pipeline_matches_scan():
     mesh = build_mesh(MeshConfig(pipe=4, data=2))
     set_mesh(mesh)
@@ -69,6 +72,7 @@ def test_pipeline_matches_scan():
     assert float(aux) == 0.0
 
 
+@requires_partial_manual
 def test_pipeline_grad_flows():
     mesh = build_mesh(MeshConfig(pipe=2, data=4))
     set_mesh(mesh)
@@ -93,6 +97,7 @@ def test_pipeline_grad_flows():
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-4)
 
 
+@requires_partial_manual
 def test_llama_pipeline_forward_matches_dense():
     config = LlamaConfig(
         vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
@@ -119,6 +124,7 @@ def test_llama_pipeline_forward_matches_dense():
     )
 
 
+@requires_partial_manual
 def test_pipeline_bf16_grad():
     """bf16 boundary arrays crash XLA:CPU without the f32-boundary cast
     in pipeline_apply; this locks the workaround in."""
@@ -145,6 +151,7 @@ def test_pipeline_bf16_grad():
     assert np.isfinite(np.asarray(gx, np.float32)).all()
 
 
+@requires_partial_manual
 class Test1F1B:
     """Loss-in-pipeline 1F1B schedule (reference default
     Interleaved1F1B): loss and all grads must match the dense path, and
@@ -248,6 +255,7 @@ class Test1F1B:
         )
 
 
+@requires_partial_manual
 def test_llama_1f1b_matches_gpipe_loss():
     """The llama training loss through the 1f1b schedule equals the
     gpipe-path loss (all tokens valid -> mean-of-means == global mean)
@@ -282,6 +290,7 @@ def test_llama_1f1b_matches_gpipe_loss():
         )
 
 
+@requires_partial_manual
 def test_llama_1f1b_padded_batch_matches_gpipe():
     """With ignore_index padding unevenly spread across microbatches,
     the 1f1b loss must still equal the gpipe/dense objective (global
@@ -313,6 +322,7 @@ def test_llama_1f1b_padded_batch_matches_gpipe():
     np.testing.assert_allclose(float(lf), float(lg), rtol=1e-5)
 
 
+@requires_partial_manual
 def test_llama_1f1b_tensor_parallel_matches_dense():
     """TP x PP x DP composition (BASELINE config #4): llama 1F1B on a
     pipe=2 x tensor=2 x fsdp=2 mesh matches the dense-mesh loss/grads,
@@ -374,6 +384,7 @@ def test_llama_1f1b_tensor_parallel_matches_dense():
             shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+@requires_partial_manual
 def test_auto_accelerate_1f1b_train_step():
     config = LlamaConfig(
         vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
@@ -398,6 +409,7 @@ def test_auto_accelerate_1f1b_train_step():
     assert np.isfinite(float(m2["loss"]))
 
 
+@requires_partial_manual
 def test_auto_accelerate_with_pipe_axis():
     config = LlamaConfig(
         vocab_size=64, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
@@ -431,6 +443,7 @@ class TestInterleaved1F1B:
     (pipeline_parallel_optimization.py:98 Interleaved1F1B)."""
 
     @pytest.mark.parametrize("S,V,M", [(2, 2, 4), (2, 2, 8), (4, 2, 8)])
+    @requires_partial_manual
     def test_matches_dense_with_layer_order(self, S, V, M):
         from dlrover_tpu.parallel.pipeline import (
             interleaved_layer_order,
@@ -486,6 +499,7 @@ class TestInterleaved1F1B:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6,
                 err_msg=name)
 
+    @requires_partial_manual
     def test_llama_interleaved_matches_dense(self):
         from dlrover_tpu.models.llama import llama_apply
         from dlrover_tpu.parallel.pipeline import interleaved_layer_order

@@ -20,6 +20,14 @@ Inside their bodies (nested defs included — they trace too):
 - ``print`` (``jax.debug.print`` is the traced alternative and is
   allowed), and ``block_until_ready`` / ``device_put`` / ``device_get``
 
+``pallas_call`` kernel bodies are walked with the same rules plus the
+kernel-specific ones: no host callbacks (``pure_callback`` /
+``io_callback`` / ``debug.callback`` — there is no host to call back
+to from a TPU core) and no ``print`` (``pl.debug_print`` is the
+in-kernel form and is allowed). Kernels are found by call form
+(``pl.pallas_call(kernel, ...)`` with ``kernel`` a same-module
+function, lambda, or ``functools.partial(kernel, ...)``).
+
 Trace-time-deliberate host work carries ``# dlint: allow-jit(reason)``.
 """
 
@@ -39,6 +47,11 @@ _JIT_NAMES = {
     "jax.experimental.pjit.pjit", "shard_map",
     "jax.experimental.shard_map.shard_map",
 }
+_PALLAS_NAMES = {
+    "pallas_call", "pl.pallas_call", "pallas.pallas_call",
+    "jax.experimental.pallas.pallas_call",
+}
+_CALLBACK_TAILS = {"pure_callback", "io_callback", "callback"}
 _PARTIAL_NAMES = {"partial", "functools.partial"}
 _TIME_CALLS = {
     "time", "sleep", "perf_counter", "monotonic", "process_time",
@@ -94,6 +107,32 @@ def _jitted_functions(src, index):
                     yield info.node, qual, "wrap-call"
 
 
+def _pallas_kernels(src, index):
+    """Yield (function node, qualname) for every function handed to a
+    ``pallas_call`` — direct, lambda, or through functools.partial."""
+    by_name: dict[str, list] = {}
+    for qual, info in index.functions.items():
+        by_name.setdefault(info.name, []).append((qual, info))
+
+    seen: set[int] = set()
+    for node in index.all_calls:
+        if call_name(node) not in _PALLAS_NAMES or not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Call) and \
+                call_name(target) in _PARTIAL_NAMES and target.args:
+            target = target.args[0]
+        if isinstance(target, ast.Lambda):
+            if id(target) not in seen:
+                seen.add(id(target))
+                yield target, f"<lambda>@{node.lineno}"
+        elif isinstance(target, ast.Name):
+            for qual, info in by_name.get(target.id, []):
+                if id(info.node) not in seen:
+                    seen.add(id(info.node))
+                    yield info.node, qual
+
+
 def _param_names(fn) -> set[str]:
     args = fn.args
     names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
@@ -104,59 +143,84 @@ def _param_names(fn) -> set[str]:
     return set(names)
 
 
+def _impurity_label(node, params, in_kernel: bool):
+    """Label for one Call node if it breaks the purity contract."""
+    name = call_name(node)
+    tail = last_attr(name) if name else ""
+    if tail == "item" and not node.args and "." in name:
+        return ".item() host sync"
+    if name == "print":
+        return (
+            "print (use pl.debug_print)" if in_kernel
+            else "print (use jax.debug.print)"
+        )
+    if "." in name and name.rpartition(".")[0] == "time" \
+            and tail in _TIME_CALLS:
+        return f"host clock read ({name})"
+    if tail in ("block_until_ready",):
+        return "block_until_ready device sync"
+    if tail in ("device_put", "device_get"):
+        return f"host transfer ({tail})"
+    if in_kernel and tail in _CALLBACK_TAILS \
+            and not (tail == name and tail == "callback") \
+            and "debug_print" not in name:
+        # pure_callback/io_callback/debug.callback: a TPU core has no
+        # host to call back to mid-kernel (pl.debug_print is the
+        # sanctioned in-kernel escape and never matches these tails).
+        # Bare `pure_callback(...)`/`io_callback(...)` are unambiguous
+        # even directly imported; only a bare generic `callback(...)`
+        # (any local helper) is exempt without a dotted qualifier.
+        return f"host callback ({name})"
+    if (
+        name.rpartition(".")[0] in _NP_HEADS
+        and tail in _NP_SYNCS
+        and node.args
+        and isinstance(node.args[0], ast.Name)
+        and node.args[0].id in params
+    ):
+        return f"{name} on traced argument '{node.args[0].id}'"
+    return None
+
+
+def _check_body(src, fn, qual, how, in_kernel, findings):
+    params = _param_names(fn)
+    def_line = getattr(fn, "lineno", 0)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    nodes = []
+    for stmt in body:
+        nodes.extend(ast.walk(stmt))
+    where = "pallas kernel" if in_kernel else "jitted function"
+    tailmsg = (
+        "host syncs cannot lower inside a TPU kernel"
+        if in_kernel else
+        "host syncs stall the compiled hot loop"
+    )
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        label = _impurity_label(node, params, in_kernel)
+        if label is None:
+            continue
+        if src.allowed("jit", node.lineno, def_line):
+            continue
+        name = call_name(node)
+        tail = last_attr(name) if name else ""
+        findings.append(Finding(
+            checker="jit-purity", code="DL005",
+            file=src.relpath, line=node.lineno,
+            message=(
+                f"{label} inside {where} {qual} ({how}) — {tailmsg}"
+            ),
+            detail=f"{qual}|{tail or name}",
+        ))
+
+
 def check_jit_purity(sources) -> list[Finding]:
     findings = []
     for src in sources:
         index = index_for(src)
         for fn, qual, how in _jitted_functions(src, index):
-            params = _param_names(fn)
-            def_line = getattr(fn, "lineno", 0)
-            body = fn.body if isinstance(body_list := fn.body, list) else [
-                body_list
-            ]
-            nodes = []
-            for stmt in body:
-                nodes.extend(ast.walk(stmt))
-            for node in nodes:
-                if not isinstance(node, ast.Call):
-                    continue
-                name = call_name(node)
-                tail = last_attr(name) if name else ""
-                label = None
-                if tail == "item" and not node.args and "." in name:
-                    label = ".item() host sync"
-                elif name == "print":
-                    label = "print (use jax.debug.print)"
-                elif "." in name and name.rpartition(".")[0] == "time" \
-                        and tail in _TIME_CALLS:
-                    label = f"host clock read ({name})"
-                elif tail in ("block_until_ready",):
-                    label = "block_until_ready device sync"
-                elif tail in ("device_put", "device_get"):
-                    label = f"host transfer ({tail})"
-                elif (
-                    name.rpartition(".")[0] in _NP_HEADS
-                    and tail in _NP_SYNCS
-                    and node.args
-                    and isinstance(node.args[0], ast.Name)
-                    and node.args[0].id in params
-                ):
-                    label = (
-                        f"{name} on traced argument "
-                        f"'{node.args[0].id}'"
-                    )
-                if label is None:
-                    continue
-                if src.allowed("jit", node.lineno, def_line):
-                    continue
-                findings.append(Finding(
-                    checker="jit-purity", code="DL005",
-                    file=src.relpath, line=node.lineno,
-                    message=(
-                        f"{label} inside jitted function {qual} "
-                        f"({how}) — host syncs stall the compiled "
-                        f"hot loop"
-                    ),
-                    detail=f"{qual}|{tail or name}",
-                ))
+            _check_body(src, fn, qual, how, False, findings)
+        for fn, qual in _pallas_kernels(src, index):
+            _check_body(src, fn, qual, "pallas_call", True, findings)
     return findings
