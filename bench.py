@@ -746,6 +746,42 @@ def main():
         restore_h2d_s = time.perf_counter() - t0
         del on_device
 
+        # in-process scale event (restart-free elasticity): rebuild the
+        # mesh over half the devices and reshard the LIVE train state
+        # onto it device-to-device via the generalized pytree reshaper
+        # — the wall-clock an elastic scale-in pays instead of a full
+        # process restart + recompile + restore. Published as
+        # ``reshape_s`` next to the restore keys so the two recovery
+        # paths are priced side by side.
+        reshape_s = -1.0
+        reshape_moved_mb = -1.0
+        ndev = len(jax.devices())
+        if ndev >= 2:
+            from jax.sharding import NamedSharding
+
+            from dlrover_tpu.parallel.mesh import (
+                MeshConfig,
+                build_mesh,
+            )
+            from dlrover_tpu.parallel.reshaper import reshape_pytree
+
+            half = jax.devices()[: ndev // 2]
+            small_mesh = build_mesh(
+                MeshConfig(data=len(half)), devices=half
+            )
+            target_sh = jax.tree.map(
+                lambda sh: NamedSharding(small_mesh, sh.spec),
+                res.state_shardings,
+                is_leaf=lambda s: isinstance(s, NamedSharding),
+            )
+            reshaped, reshape_report = reshape_pytree(
+                state, target_sh
+            )
+            _ = float(jax.tree.leaves(reshaped.params)[0].ravel()[0])
+            reshape_s = reshape_report.seconds
+            reshape_moved_mb = reshape_report.bytes_moved / 1e6
+            del reshaped
+
         # engine-limited save throughput at HEADLINE size: the full
         # engine path (lock, barrier, meta build, shm reserve, chunked
         # double-buffered drain) over a host-resident state the size of
@@ -969,6 +1005,12 @@ def main():
             "restore_disk_verify_s": round(restore_disk_verify_s, 3),
             "restore_h2d_s": round(restore_h2d_s, 3),
             "restore_h2d_mode": "pipelined-per-leaf",
+            # in-process scale event (mesh rebuild + batched
+            # device-to-device reshard of the live train state onto
+            # half the devices) — what a restart-free membership
+            # change costs instead of teardown + recompile + restore
+            "reshape_s": round(reshape_s, 3),
+            "reshape_moved_mb": round(reshape_moved_mb, 1),
             # host-arena reuse for the deep-verify CRC staging buffers
             # (the COLD-save fix is the threaded shm prefault, not the
             # arena — see ckpt_engine_cold_gbps above)

@@ -217,6 +217,17 @@ class MasterClient:
             retries=2,
         )
 
+    def drain_node(self, node_rank: int) -> bool:
+        """Graceful scale-in announcement: ``node_rank`` leaves the job
+        with its host still alive, so survivors get a "drained"
+        departure (reshape in place, shards readable device-to-device)
+        instead of the "dead" a heartbeat timeout would record. Called
+        by a platform scaler or by a preempted node's agent ahead of
+        its own shutdown."""
+        return self._report(
+            msg.DrainNodeRequest(node_rank=node_rank)
+        )
+
     def get_comm_world(self, rdzv_name: str, node_rank: int):
         world: msg.CommWorld = self._get(
             msg.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)

@@ -103,6 +103,16 @@ class ElasticLaunchConfig:
     # to come back before logging the outage as (still) lost; workers
     # keep training either way and the agent re-probes on its next tick
     master_ride_through: float = JobConstant.MASTER_RIDE_THROUGH_DEFAULT
+    # restart-free elasticity: on a membership change where the master's
+    # verdict for this node is "reshape" AND every local worker
+    # advertised a reshape watcher, signal the live workers to rebuild
+    # their mesh in process instead of restarting them. Workers without
+    # a watcher (or a failed/timed-out reshape) keep the classic
+    # restart path, so this is safe to leave on.
+    reshape_in_process: bool = True
+    # how long the agent waits for all local workers to ack an
+    # in-process reshape before falling back to the restart path
+    reshape_ack_timeout: float = 60.0
 
     def auto_configure_params(self):
         """--auto-config: infer process count from visible devices."""
@@ -123,6 +133,18 @@ class WorkerSpec:
         self.entrypoint = entrypoint
         self.args = args
         self.config = config
+
+
+def world_rank_offset(world: dict, node_rank: int) -> int:
+    """Global-rank offset of ``node_rank`` in a formed world: the local
+    world sizes of every lower rank, summed in sorted order. One
+    definition shared by spawn-time rank assignment and reshape-time
+    signaling — the two must never disagree on a worker's global rank."""
+    return sum(
+        size
+        for rank, size in sorted(world.items())
+        if rank < node_rank
+    )
 
 
 class MasterRendezvousHandler:
@@ -206,12 +228,7 @@ class MasterRendezvousHandler:
                     f"{self._timeout}s (world={getattr(world, 'world', None)})"
                 )
             time.sleep(1)
-        ranks = sorted(world.world.keys())
-        rank_offset = 0
-        for r in ranks:
-            if r == self._node_rank:
-                break
-            rank_offset += world.world[r]
+        rank_offset = world_rank_offset(world.world, self._node_rank)
         total = sum(world.world.values())
         # Rendezvous can block for the whole elastic-wait window; reset
         # stall clocks in THIS process so the wait is not read as a
@@ -323,6 +340,11 @@ class ElasticTrainingAgent:
         # already been flight-dumped (one artifact per episode, not one
         # per monitor tick); cleared when the verdict clears
         self._hang_episode_dumped = False
+        # restart-free elasticity: the rendezvous round the running
+        # workers were spawned into (or last reshaped to), and the
+        # per-local-rank agent<->worker reshape channels
+        self._last_round = -1
+        self._reshape_channels: dict[int, object] = {}
 
     # ----------------------------------------------------------- lifecycle
 
@@ -374,6 +396,7 @@ class ElasticTrainingAgent:
             total,
             self._rdzv_handler.last_restore_step,
         )
+        self._last_round = rdzv_round
         self._start_worker_processes(rank_offset, total, coordinator)
 
     def _worker_env(self, local_rank: int, global_rank: int, total: int, coordinator: str):
@@ -418,6 +441,21 @@ class ElasticTrainingAgent:
         # master-brokered consensus restore step rides the env so the
         # engine restores exactly the agreed step.
         env[telemetry.ENV_ROLE] = "worker"
+        if self._config.reshape_in_process:
+            # per-worker reshape channel: a fresh incarnation must not
+            # see the previous incarnation's request/ack/ready files
+            from dlrover_tpu.trainer.elastic.reshape import (
+                ReshapeChannel,
+            )
+
+            rdir = os.path.join(
+                self._config.log_dir or "/tmp/dlrover_tpu/logs",
+                f"reshape_{self._config.node_rank}_{local_rank}",
+            )
+            channel = ReshapeChannel(rdir)
+            channel.clear()
+            self._reshape_channels[local_rank] = channel
+            env[NodeEnv.RESHAPE_DIR] = rdir
         restore_step = self._rdzv_handler.last_restore_step
         if restore_step >= 0:
             env[NodeEnv.RESTORE_STEP] = str(restore_step)
@@ -668,10 +706,12 @@ class ElasticTrainingAgent:
             # triggers a local flight-recorder dump (the worker's own
             # detector may be the thing that's stuck)
             self._poll_diagnosis()
-            # check membership changes
+            # check membership changes: a waiting node, or a round the
+            # master already re-formed from carried-over survivors
+            # (reshape-first elasticity forms rounds without survivors
+            # re-joining, so waiting can drop back to 0 between ticks)
             if self._membership_changed():
-                logger.info("membership changed; restarting workers")
-                self._restart_workers()
+                self._handle_membership_change()
             if self._heartbeat.action == "stop":
                 logger.info("master asked this node to stop")
                 self._stop_workers()
@@ -708,13 +748,158 @@ class ElasticTrainingAgent:
             waiting = self._client.num_nodes_waiting(
                 RendezvousName.ELASTIC_TRAINING
             )
-            return waiting > 0
+            if waiting > 0:
+                return True
+            # carried-over survivors never re-join, so the new round
+            # can form (and waiting return to 0) entirely between two
+            # monitor ticks — compare the formed round number too
+            world = self._client.get_comm_world(
+                RendezvousName.ELASTIC_TRAINING, self._config.node_rank
+            )
+            return bool(
+                world and world.world and world.round != self._last_round
+            )
         except (ConnectionError, OSError):
             # master unreachable, not a membership change: ride through
             # (workers keep training on their last formed world)
             self._ride_through_master_outage()
             return False
         except Exception:  # noqa: BLE001
+            return False
+
+    # ------------------------------------------- reshape-first elasticity
+
+    def _workers_alive(self) -> bool:
+        return bool(self._workers) and all(
+            w.returncode is None for w in self._workers
+        )
+
+    def _workers_reshape_ready(self) -> bool:
+        """Every local worker advertised a reshape watcher (the Trainer
+        writes the ready marker when it installs one). Bare workers
+        keep the classic restart path."""
+        if not self._config.reshape_in_process:
+            return False
+        channels = [
+            self._reshape_channels.get(w.local_rank)
+            for w in self._workers
+        ]
+        return bool(channels) and all(
+            c is not None and c.worker_ready() for c in channels
+        )
+
+    def _await_formed_world(self, timeout: float):
+        """Poll the master until the NEXT round is formed with this
+        node in it (polling is also what triggers formation once the
+        waiting set is ready). None = timeout, excluded, or a worker
+        died while waiting."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if not self._workers_alive():
+                return None
+            try:
+                world = self._client.get_comm_world(
+                    RendezvousName.ELASTIC_TRAINING,
+                    self._config.node_rank,
+                )
+            except (ConnectionError, OSError):
+                time.sleep(1.0)
+                continue
+            if world and world.world and world.round != self._last_round:
+                if self._config.node_rank not in world.world:
+                    return None
+                return world
+            time.sleep(0.5)
+        return None
+
+    def _handle_membership_change(self):
+        """Reshape-first: when the master's verdict for this node is
+        "reshape" and every local worker runs a reshape watcher, the
+        membership change is signaled INTO the live workers (drain ->
+        in-process mesh rebuild + reshard -> resume). Everything else
+        — no watcher, verdict "restart", excluded from the round, a
+        failed or timed-out reshape, a worker killed mid-reshape —
+        falls back to the classic restart path."""
+        if not self._workers_reshape_ready() or not self._workers_alive():
+            logger.info("membership changed; restarting workers")
+            self._restart_workers()
+            return
+        world = self._await_formed_world(
+            min(self._config.rdzv_timeout, 120.0)
+        )
+        if world is None:
+            logger.info(
+                "membership changed but no new round formed with this "
+                "node; restarting workers"
+            )
+            self._restart_workers()
+            return
+        verdict = (getattr(world, "verdicts", None) or {}).get(
+            self._config.node_rank, "restart"
+        )
+        if verdict != "reshape":
+            logger.info(
+                "membership changed (verdict=%s); restarting workers",
+                verdict,
+            )
+            self._restart_workers()
+            return
+        if self._signal_reshape(world):
+            self._last_round = world.round
+            telemetry.event(
+                "elastic.reshape.adopted",
+                round=world.round,
+                world=len(world.world),
+            )
+            logger.info(
+                "round %s adopted in process (no worker restart)",
+                world.round,
+            )
+        else:
+            logger.warning(
+                "in-process reshape for round %s failed or timed out; "
+                "falling back to the restart path", world.round,
+            )
+            self._restart_workers()
+
+    def _signal_reshape(self, world) -> bool:
+        """Write the reshape request to every local worker and wait for
+        all acks. False = restart fallback required."""
+        from dlrover_tpu.trainer.elastic.reshape import ReshapeRequest
+
+        request = ReshapeRequest(
+            round=world.round,
+            world=world.world,
+            rank_offset=world_rank_offset(
+                world.world, self._config.node_rank
+            ),
+            total=sum(world.world.values()),
+            coordinator=world.coordinator_addr,
+            departed=dict(getattr(world, "departed", None) or {}),
+        )
+        try:
+            for w in self._workers:
+                self._reshape_channels[w.local_rank].signal(request)
+            deadline = time.time() + self._config.reshape_ack_timeout
+            for w in self._workers:
+                channel = self._reshape_channels[w.local_rank]
+                ack = channel.await_ack(
+                    world.round,
+                    max(deadline - time.time(), 0.1),
+                    alive_fn=lambda w=w: w.returncode is None,
+                )
+                if ack is None or not ack.get("ok"):
+                    return False
+            return True
+        except Exception:  # noqa: BLE001 - the signal write is itself
+            # a fault seam (elastic.signal chaos site, ENOSPC on the
+            # request file): a failed signal must DEGRADE to the
+            # restart path, never crash the agent out of its monitor
+            # loop with workers still running
+            logger.exception(
+                "reshape signaling for round %s failed; falling back "
+                "to the restart path", world.round,
+            )
             return False
 
     # ------------------------------------------------- master ride-through

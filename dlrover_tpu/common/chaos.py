@@ -7,7 +7,8 @@ recovery invariants only hold when failures are injected *systematically*.
 This module is the one place every fault comes from: named **fault
 sites** threaded through the control plane (``rpc.send``, ``rpc.recv``,
 ``ipc.request``, ``agent.spawn``, ``ckpt.write``, ``ckpt.manifest``,
-``ckpt.save``, ``rdzv.join``, ``master.kill``) consult a seeded schedule
+``ckpt.save``, ``rdzv.join``, ``master.kill``, ``elastic.signal``,
+``elastic.reshape``) consult a seeded schedule
 that can drop or
 delay RPC frames, kill or hang a process at a chosen step, tear a
 checkpoint payload mid-shard, or bit-flip persisted bytes.
@@ -383,6 +384,30 @@ NAMED_SCHEDULES: dict[str, dict] = {
         "seed": 17,
         "rules": [
             {"site": "ckpt.manifest", "action": "bitflip", "step": 8},
+        ],
+    },
+    # flap membership against a live worker: the first two membership
+    # changes (scale-in drain, scale-out adopt) must ride IN PROCESS —
+    # zero worker restarts — then a kill lands mid-reshard on the third
+    # and the agent must fall back to the classic restart path with
+    # every dataset shard still served exactly once. The scale events
+    # themselves are driven by the harness (tools/chaos_run.py
+    # ``_run_scale_flap``); the schedule contributes the mid-reshape
+    # kill. ``after: 2`` counts the worker-side ``reshard`` seams: the
+    # flap's two in-process adoptions pass clean, the third dies.
+    "scale-flap": {
+        "desc": "flap membership: scale-in drain + scale-out adopt ride "
+        "in process (zero worker restarts), then a kill mid-reshard "
+        "must recover via the restart path with exactly-once shards",
+        "seed": 23,
+        "rules": [
+            {
+                "site": "elastic.reshape",
+                "action": "kill",
+                "verb": "reshard",
+                "after": 2,
+                "max": 1,
+            },
         ],
     },
     # kill the MASTER mid-job (on the 7th dataset task request, before

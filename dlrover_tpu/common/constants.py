@@ -184,6 +184,12 @@ class NodeEnv:
     # their local newest.
     RESTORE_STEP = "DLROVER_TPU_RESTORE_STEP"
     RESTART_COUNT = "TORCHELASTIC_RESTARTS"
+    # Restart-free elasticity: directory of the agent<->worker reshape
+    # channel (trainer/elastic/reshape.py). When set, the Trainer
+    # installs a reshape watcher and advertises readiness; the agent
+    # then signals membership changes into the live worker instead of
+    # restarting it.
+    RESHAPE_DIR = "DLROVER_TPU_RESHAPE_DIR"
 
 
 class ConfigPath:

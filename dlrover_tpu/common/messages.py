@@ -153,6 +153,28 @@ class CommWorld(Message):
     # step restorable on every member of the round (-1 = no forcing:
     # some member reported nothing, or no common step exists)
     restore_step: int = -1
+    # reshape-first elasticity: per-member verdict for THIS round —
+    # node_rank -> "reshape" (the host rode through the membership
+    # change; its agent signals the live workers to rebuild the mesh in
+    # process) | "restart" (fresh worker processes). ``departed`` maps
+    # ranks that left the round to HOW they left: "drained" (host alive
+    # at the drain point, shards readable device-to-device) vs "dead"
+    # (its exclusively-held shards are lost; checkpoint fallback).
+    verdicts: dict = field(default_factory=dict)
+    departed: dict = field(default_factory=dict)
+
+
+@dataclass
+class DrainNodeRequest(Message):
+    """Graceful scale-in: the platform scaler (or a preempted node's
+    own agent, ahead of its shutdown) announces that ``node_rank`` is
+    leaving the job while its host is still ALIVE. The rendezvous
+    manager records the departure as "drained" — survivors reshape in
+    place reading the leaver's shards device-to-device — instead of
+    the "dead" a heartbeat-timeout removal forces (checkpoint fallback
+    for anything the leaver exclusively held)."""
+
+    node_rank: int = 0
 
 
 @dataclass

@@ -433,7 +433,8 @@ def install_from_env() -> TelemetryRegistry | None:
 # -------------------------------------------------------------------------
 
 CATEGORIES = (
-    "productive", "compile", "checkpoint", "restart", "rendezvous", "idle",
+    "productive", "compile", "checkpoint", "reshape", "restart",
+    "rendezvous", "idle",
 )
 
 # kind -> ledger category, for events that carry a ``dur`` interval.
@@ -454,6 +455,11 @@ EVENT_CATEGORY = {
     # restart attribution, so the transfer leg stays visible
     "ckpt.restore.h2d": "checkpoint",
     "rdzv.wait": "rendezvous",
+    # in-process mesh reshape on a membership change (drain -> reshard
+    # -> resume, no process restart): its own bucket so the goodput
+    # ledger can price a scale event at seconds instead of burying it
+    # in ``restart``
+    "elastic.reshape": "reshape",
     # the agent's master-outage ride-through: emitted with the outage
     # duration once the (restarted) master answers again. Charged to
     # ``restart`` — anything workers productively overlapped still wins
@@ -465,8 +471,13 @@ EVENT_CATEGORY = {
 # overlap resolution, highest first (a checkpoint pause inside a step
 # window counts as checkpoint only if the step didn't claim it; the
 # agent's rendezvous wait must show through the coarse dead-worker
-# restart gap it sits inside)
-_PRIORITY = ("productive", "compile", "checkpoint", "rendezvous", "restart")
+# restart gap it sits inside; a reshape's internal checkpoint pull
+# (``ckpt.restore``/``.h2d`` sub-intervals) stays charged to the
+# reshape, which is why reshape outranks checkpoint)
+_PRIORITY = (
+    "productive", "compile", "reshape", "checkpoint", "rendezvous",
+    "restart",
+)
 
 
 def _interval_events(snap: dict):

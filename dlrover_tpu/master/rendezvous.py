@@ -68,6 +68,17 @@ class RendezvousManager:
         # the SAME step (a step any host lacks is never forced)
         self._verified_steps: dict[int, frozenset] = {}
         self._restore_step = -1
+        # reshape-first elasticity: members of a dissolved round whose
+        # host rode through (they were carried back into waiting by a
+        # membership change, NOT by their own re-join) reshape their
+        # mesh in process; everyone else restarts. The verdict is
+        # per-member, computed when the next round forms.
+        self._carryover: set[int] = set()
+        # rank -> "dead" | "drained", accumulated between rounds
+        self._departed_pending: dict[int, str] = {}
+        # the latest formed round's per-member verdicts + departures
+        self._verdicts: dict[int, str] = {}
+        self._departed: dict[int, str] = {}
 
     def update_rdzv_params(
         self, min_nodes, max_nodes, waiting_timeout, node_unit
@@ -91,19 +102,38 @@ class RendezvousManager:
         formed round, dissolve the round — survivors go back to waiting so
         their agents see a membership change and re-rendezvous instead of
         blocking in collectives with a dead peer."""
+        self._remove_node(node_rank, reason="dead")
+
+    def drain_node(self, node_rank: int):
+        """Graceful scale-in: the node leaves the job but its host is
+        alive at the drain point, so survivors can still read its
+        shards device-to-device — the departed reason \"drained\" tells
+        them no state was lost (vs \"dead\", where shards on that host
+        are gone and must come from the checkpoint)."""
+        self._remove_node(node_rank, reason="drained")
+
+    def _remove_node(self, node_rank: int, reason: str):
+        """Drop a node from waiting, and if it was part of the formed
+        round, dissolve the round — survivors are carried back into
+        waiting (verdict \"reshape\" for the next round: their agents
+        ride through instead of restarting workers)."""
         with self._lock:
             removed = self._waiting_nodes.pop(node_rank, None) is not None
             self._verified_steps.pop(node_rank, None)
+            self._carryover.discard(node_rank)
             if node_rank in self._rdzv_nodes:
                 self._rdzv_nodes.pop(node_rank)
                 for rank, info in self._rdzv_nodes.items():
                     self._waiting_nodes.setdefault(rank, info)
+                    self._carryover.add(rank)
                 self._rdzv_nodes = {}
                 self._first_join_time = time.time()
+                self._departed_pending[node_rank] = reason
                 removed = True
             if removed:
                 logger.info(
-                    "%s: removed dead node %s", self.name, node_rank
+                    "%s: removed %s node %s", self.name, reason,
+                    node_rank,
                 )
 
     @staticmethod
@@ -140,7 +170,20 @@ class RendezvousManager:
                 self._verified_steps[node_rank] = self._step_set(
                     verified_ckpt_step, verified_ckpt_steps
                 )
-                # joining invalidates the current formed round
+                # joining invalidates the current formed round; its
+                # members are CARRIED into the next round's waiting set
+                # (verdict "reshape": their agents ride through the
+                # membership change instead of re-joining), while an
+                # explicit join — this node — always means fresh worker
+                # processes, so it can never be a carryover
+                if self._rdzv_nodes:
+                    for rank, info in self._rdzv_nodes.items():
+                        if rank == node_rank:
+                            continue
+                        self._waiting_nodes.setdefault(rank, info)
+                        self._carryover.add(rank)
+                    self._first_join_time = time.time()
+                self._carryover.discard(node_rank)
                 self._rdzv_nodes = {}
                 return self._rdzv_round
 
@@ -182,6 +225,25 @@ class RendezvousManager:
         for r in ranks:
             self._waiting_nodes.pop(r, None)
         self._rdzv_round += 1
+        # reshape-vs-restart verdict per member: a carryover (its host
+        # rode through the membership change without re-joining) keeps
+        # its worker processes and reshapes the mesh in process;
+        # everyone else starts fresh worker processes. ``departed``
+        # records who left and HOW — "drained" hosts were alive at the
+        # drain point (survivors read their shards device-to-device),
+        # "dead" hosts took their shards with them (checkpoint
+        # fallback for anything they exclusively held).
+        self._verdicts = {
+            r: ("reshape" if r in self._carryover else "restart")
+            for r in ranks
+        }
+        self._departed = {
+            r: reason
+            for r, reason in self._departed_pending.items()
+            if r not in ranks
+        }
+        self._carryover = set()
+        self._departed_pending = {}
         # restore-step consensus: the NEWEST step every member can
         # actually load. Forcing min-of-newest instead would pick steps
         # some hosts pruned or never persisted, and those hosts would
@@ -208,15 +270,21 @@ class RendezvousManager:
             round=self._rdzv_round,
             world=len(ranks),
             restore_step=self._restore_step,
+            reshape=sum(
+                1 for v in self._verdicts.values() if v == "reshape"
+            ),
+            departed=len(self._departed),
             dur=max(time.time() - self._first_join_time, 0.0),
         )
         logger.info(
             "%s rendezvous round %d formed with nodes %s "
-            "(consensus restore step %s)",
+            "(consensus restore step %s, verdicts %s, departed %s)",
             self.name,
             self._rdzv_round,
             ranks,
             self._restore_step,
+            self._verdicts,
+            self._departed,
         )
 
     def get_comm_world(self, node_rank: int):
@@ -232,6 +300,22 @@ class RendezvousManager:
         across steps."""
         with self._lock:
             return self._restore_step
+
+    def round_verdicts(self, round_: int | None = None) -> tuple[dict, dict]:
+        """(verdicts, departed) of the latest formed round: node_rank ->
+        "reshape"|"restart", and departed node_rank -> "dead"|"drained".
+
+        ``round_`` guards callers that read the world and its verdicts
+        under SEPARATE lock acquisitions (the servicer): if the round
+        dissolved and re-formed in between, attaching round-N+1
+        verdicts to a round-N world would hand an agent a "reshape"
+        verdict for a world it should restart into — mismatches return
+        empty dicts instead (the agent's poll loop picks up the fresh
+        round next tick)."""
+        with self._lock:
+            if round_ is not None and round_ != self._rdzv_round:
+                return {}, {}
+            return dict(self._verdicts), dict(self._departed)
 
     def clear_waiting_nodes(self):
         with self._lock:
@@ -278,6 +362,21 @@ class RendezvousManager:
                 "restore_step": self._restore_step,
                 "first_join_time": self._first_join_time,
                 "coordinator_port": self._coordinator_port,
+                # reshape-first elasticity: the verdicts of the formed
+                # round (and who left, and how) must survive a master
+                # failover — a surviving agent polling the restored
+                # master mid-reshape still needs its "reshape" verdict
+                "carryover": sorted(self._carryover),
+                "departed_pending": {
+                    str(r): v
+                    for r, v in self._departed_pending.items()
+                },
+                "verdicts": {
+                    str(r): v for r, v in self._verdicts.items()
+                },
+                "departed": {
+                    str(r): v for r, v in self._departed.items()
+                },
             }
 
     def restore_state(self, state: dict) -> None:
@@ -310,6 +409,23 @@ class RendezvousManager:
             self._coordinator_port = int(
                 state.get("coordinator_port", 0)
             )
+            self._carryover = {
+                int(r) for r in state.get("carryover", [])
+            }
+            self._departed_pending = {
+                int(r): str(v)
+                for r, v in (
+                    state.get("departed_pending") or {}
+                ).items()
+            }
+            self._verdicts = {
+                int(r): str(v)
+                for r, v in (state.get("verdicts") or {}).items()
+            }
+            self._departed = {
+                int(r): str(v)
+                for r, v in (state.get("departed") or {}).items()
+            }
         logger.info(
             "%s: restored round %d with members %s (waiting %s)",
             self.name, self._rdzv_round,

@@ -160,6 +160,9 @@ class MasterServicer(RpcService):
         # durable control-plane state (master failover); set by the
         # owning JobMaster when a state dir is configured
         self.state_store = None
+        # rdzv_name -> last formed round already persisted via
+        # _mark_dirty (steady-state world polls must not re-dirty)
+        self._marked_rounds: dict[str, int] = {}
         self._start_training_time = 0.0
         self._job_ended = threading.Event()
         self._job_success = True
@@ -306,6 +309,14 @@ class MasterServicer(RpcService):
         if isinstance(message, msg.ElasticRunConfig):
             self.set_run_configs(message.configs)
             self._mark_dirty()
+            return True
+        if isinstance(message, msg.DrainNodeRequest):
+            mgr = self.rdzv_managers.get(
+                RendezvousName.ELASTIC_TRAINING
+            )
+            if mgr is not None:
+                mgr.drain_node(message.node_rank)
+                self._mark_dirty()
             return True
         if isinstance(message, msg.RdzvParamsReport):
             for mgr in self.rdzv_managers.values():
@@ -570,10 +581,22 @@ class MasterServicer(RpcService):
         rdzv_round, group, world, coordinator = mgr.get_comm_world(
             request.node_id
         )
-        if world:
+        if world and self._marked_rounds.get(request.rdzv_name) != rdzv_round:
             # this poll may just have FORMED the round — the membership
-            # and consensus step must survive a master failover
+            # and consensus step must survive a master failover. Only
+            # the round TRANSITION dirties the snapshot: agents poll
+            # the formed world every monitor tick (reshape-first
+            # membership detection), and re-marking on every poll
+            # would make the snapshot writer persist unchanged state
+            # forever.
+            self._marked_rounds[request.rdzv_name] = rdzv_round
             self._mark_dirty()
+        # pass rdzv_round so a round dissolved+re-formed between the
+        # two manager calls cannot attach the new round's verdicts to
+        # this (stale) world
+        verdicts, departed = (
+            mgr.round_verdicts(rdzv_round) if world else ({}, {})
+        )
         return msg.CommWorld(
             rdzv_name=request.rdzv_name,
             round=rdzv_round,
@@ -583,6 +606,8 @@ class MasterServicer(RpcService):
             restore_step=(
                 mgr.consensus_restore_step() if world else -1
             ),
+            verdicts=verdicts,
+            departed=departed,
         )
 
     def _get_paral_config(self, node_type, node_id):

@@ -213,6 +213,7 @@ def auto_accelerate(
     has_aux: bool = False,
     seed: int = 0,
     infer_out_shardings: bool = False,
+    reuse_state: Optional[TrainState] = None,
 ) -> AccelerateResult:
     """Build mesh + sharded state + jitted train step for ``strategy``.
 
@@ -226,6 +227,12 @@ def auto_accelerate(
     remat_policy="dots_attn_offload") — explicit out_shardings plus
     offload placement annotations trip an XLA RET_CHECK in this build;
     strategy.remat="offload" switches automatically.
+
+    ``reuse_state``: skip the jitted init and adopt an existing
+    TrainState (already laid out on THIS mesh's shardings — the elastic
+    in-process reshape hands the resharded live state back in here so a
+    membership change rebuilds the step function without
+    re-initializing or restoring anything).
     """
     import jax
     import jax.numpy as jnp
@@ -253,10 +260,13 @@ def auto_accelerate(
             step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
         )
 
-    with mesh:
-        state = jax.jit(init_state, out_shardings=state_shardings)(
-            jax.random.key(seed)
-        )
+    if reuse_state is not None:
+        state = reuse_state
+    else:
+        with mesh:
+            state = jax.jit(init_state, out_shardings=state_shardings)(
+                jax.random.key(seed)
+            )
 
     # ---- train step --------------------------------------------------------
     compute_dtype = strategy.compute_dtype

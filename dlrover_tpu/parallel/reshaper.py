@@ -1,0 +1,246 @@
+"""Generalized pytree resharding: old sharding -> new sharding, batched.
+
+Equivalent capability: the reference DS hybrid engine reshapes weights
+between the training and inference layouts (atorch rl/ds_hybrid_engine/)
+— one model, two layouts, device-to-device movement.  This module
+generalizes that proven path (``rl/model_engine.ModelEngine.reshard``)
+into a layout mover for *any* state pytree, so the elastic trainer can
+reshape params/opt-state in place on a membership change instead of
+paying a process restart + recompile + full restore.
+
+Three layers:
+
+- :func:`batched_device_put` — the transfer discipline both the RL
+  hybrid-engine reshard and the elastic reshaper share: every leaf's
+  ``device_put`` is DISPATCHED before any is waited on (XLA moves the
+  shards device-to-device; through a multiplexing link the in-flight
+  copies pipeline instead of paying serial per-leaf round trips), then
+  ONE ``block_until_ready`` barrier at the end.
+- :func:`survivors_cover` — can a leaf be rebuilt from shards living on
+  surviving devices alone?  Replicated and partially-replicated leaves
+  survive the loss of a host; a leaf sharded across a dead host cannot
+  be moved device-to-device and must fall back to the checkpoint.
+- :func:`reshape_pytree` — the elastic entry point: movable leaves ride
+  one batched device-to-device dispatch, lost leaves are pulled through
+  a caller-provided fallback (shm/storage checkpoint reader), and the
+  report says exactly what moved vs. what was pulled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def batched_device_put(tree, shardings=None):
+    """Re-lay every leaf of ``tree`` onto ``shardings`` (a matching
+    pytree of shardings, or None = default placement): all transfers
+    dispatched up front, one barrier at the end.
+
+    Returns ``(new_tree, seconds)``.  The single barrier is the whole
+    point — a per-leaf ``block_until_ready`` serializes the transfers
+    and turns an n-leaf reshard into n round trips.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if shardings is None:
+        sharding_leaves = [None] * len(leaves)
+    else:
+        sharding_leaves = jax.tree_util.tree_leaves(
+            shardings,
+            is_leaf=lambda s: s is None or hasattr(s, "device_set")
+            or hasattr(s, "devices"),
+        )
+        if len(sharding_leaves) != len(leaves):
+            raise ValueError(
+                f"shardings pytree has {len(sharding_leaves)} leaves, "
+                f"state has {len(leaves)}"
+            )
+    out = []
+    for leaf, sh in zip(leaves, sharding_leaves):
+        # dispatch only: device_put returns before the copy completes
+        out.append(
+            jax.device_put(leaf) if sh is None else jax.device_put(
+                leaf, sh
+            )
+        )
+    jax.block_until_ready(out)
+    return (
+        jax.tree_util.tree_unflatten(treedef, out),
+        time.perf_counter() - t0,
+    )
+
+
+def _shard_key(index) -> tuple | None:
+    if index is None:
+        return None
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+def survivors_cover(arr, lost_device_ids) -> bool:
+    """True when the shards of ``arr`` living OUTSIDE ``lost_device_ids``
+    still tile the full global array — i.e. a device-to-device reshard
+    reads no byte that died with a lost host.  Non-jax leaves (host
+    numpy) trivially survive: they live in this process."""
+    import jax
+
+    if not isinstance(arr, jax.Array):
+        return True
+    lost = set(lost_device_ids)
+    if not lost:
+        return True
+    surviving: dict = {}
+    for shard in arr.global_shards:
+        if shard.device.id in lost:
+            continue
+        key = _shard_key(shard.index)
+        surviving.setdefault(key, shard)
+    if not surviving:
+        return False
+    # a replicated array has one distinct index (None or full-extent)
+    total = int(np.prod(arr.shape, dtype=np.int64)) if arr.shape else 1
+    have = 0
+    for key, shard in surviving.items():
+        if key is None:
+            return True  # fully replicated survivor
+        have += int(
+            np.prod(
+                [
+                    (arr.shape[d] if stop is None else stop)
+                    - (0 if start is None else start)
+                    for d, (start, stop, _step) in enumerate(key)
+                ],
+                dtype=np.int64,
+            )
+        )
+    # unique shards never overlap, so covering volume == full volume
+    return have >= total
+
+
+@dataclasses.dataclass
+class ReshapeReport:
+    """What a :func:`reshape_pytree` actually did."""
+
+    moved: int = 0            # leaves moved device-to-device
+    pulled: int = 0           # leaves pulled through the fallback
+    lost_leaves: list = dataclasses.field(default_factory=list)
+    seconds: float = 0.0      # total wall-clock of the reshape
+    move_seconds: float = 0.0  # the batched device-to-device leg
+    bytes_moved: int = 0
+
+
+def _leaf_nbytes(leaf) -> int:
+    shape = np.shape(leaf)
+    dtype = getattr(leaf, "dtype", None)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    return int(np.prod(shape, dtype=np.int64)) * itemsize
+
+
+def reshape_pytree(
+    tree,
+    target_shardings,
+    lost_devices=(),
+    fallback: Optional[Callable] = None,
+    names: Optional[list] = None,
+):
+    """Move a state pytree onto new shardings, device-to-device where
+    the source shards survived, checkpoint-fallback where they did not.
+
+    ``target_shardings``: pytree matching ``tree`` of target shardings.
+    ``lost_devices``: device ids whose HBM died with their host — any
+    leaf whose surviving shards do not cover its global shape is LOST.
+    ``fallback(requests)``: called once with ``{name:
+    jax.ShapeDtypeStruct(with sharding)}`` for every lost leaf; must
+    return ``{name: array}`` already laid out on the target sharding
+    (the flash-checkpoint engine's targeted shard-wise load is exactly
+    this shape).  Without a fallback, a lost leaf raises.
+    ``names``: per-leaf names aligned with ``jax.tree_util`` flatten
+    order — pass the same names the checkpoint engine uses so the
+    fallback requests address real checkpoint leaves.
+
+    Returns ``(new_tree, ReshapeReport)``.
+    """
+    import jax
+
+    t_start = time.perf_counter()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sharding_leaves = jax.tree_util.tree_leaves(
+        target_shardings,
+        is_leaf=lambda s: s is None or hasattr(s, "device_set")
+        or hasattr(s, "devices"),
+    )
+    if len(sharding_leaves) != len(leaves):
+        raise ValueError(
+            f"target_shardings has {len(sharding_leaves)} leaves, "
+            f"state has {len(leaves)}"
+        )
+    if names is None:
+        names = [f"leaf{i}" for i in range(len(leaves))]
+    if len(names) != len(leaves):
+        raise ValueError(
+            f"{len(names)} names for {len(leaves)} leaves"
+        )
+    movable_idx: list[int] = []
+    lost_idx: list[int] = []
+    for i, leaf in enumerate(leaves):
+        if survivors_cover(leaf, lost_devices):
+            movable_idx.append(i)
+        else:
+            lost_idx.append(i)
+    report = ReshapeReport(
+        moved=len(movable_idx),
+        pulled=len(lost_idx),
+        lost_leaves=[names[i] for i in lost_idx],
+    )
+    if lost_idx and fallback is None:
+        raise ValueError(
+            f"{len(lost_idx)} leaves lost their only shards (e.g. "
+            f"{report.lost_leaves[:3]}) and no fallback loader was "
+            f"given — cannot reshape without losing state"
+        )
+    new_leaves: list = [None] * len(leaves)
+    if movable_idx:
+        moved_tree, move_s = batched_device_put(
+            [leaves[i] for i in movable_idx],
+            [sharding_leaves[i] for i in movable_idx],
+        )
+        report.move_seconds = move_s
+        for i, arr in zip(movable_idx, moved_tree):
+            new_leaves[i] = arr
+            report.bytes_moved += _leaf_nbytes(arr)
+    if lost_idx:
+        requests = {}
+        for i in lost_idx:
+            leaf = leaves[i]
+            sds = jax.ShapeDtypeStruct(
+                np.shape(leaf),
+                getattr(leaf, "dtype", np.dtype(np.float32)),
+                sharding=sharding_leaves[i],
+            )
+            requests[names[i]] = sds
+        pulled = fallback(requests)
+        missing = [n for n in requests if n not in pulled]
+        if missing:
+            raise ValueError(
+                f"fallback loader did not return lost leaves "
+                f"{missing[:3]} ({len(missing)} total)"
+            )
+        for i in lost_idx:
+            new_leaves[i] = pulled[names[i]]
+    report.seconds = time.perf_counter() - t_start
+    logger.info(
+        "reshaped pytree: %d leaves moved device-to-device "
+        "(%.1f MB, %.3fs), %d pulled from fallback, %.3fs total",
+        report.moved, report.bytes_moved / 1e6, report.move_seconds,
+        report.pulled, report.seconds,
+    )
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), report

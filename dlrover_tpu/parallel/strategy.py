@@ -103,6 +103,7 @@ class Strategy:
 
 
 def save_strategy(strategy: Strategy, path: str) -> None:
+    # dlint: allow-chaos(operator-invoked config dump, not a recovery seam)
     with open(path, "w") as f:
         f.write(strategy.to_json())
 

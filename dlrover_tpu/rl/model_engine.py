@@ -21,7 +21,6 @@ moves the shards, no gather-to-host.
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Any, Callable, Optional
 
 from dlrover_tpu.common.log import get_logger
@@ -185,7 +184,12 @@ class ModelEngine:
 
         XLA moves shards device-to-device (resharding device_put), so
         e.g. fsdp=4-sharded training weights become tensor=2-sharded
-        decode weights without a host round-trip.
+        decode weights without a host round-trip.  The transfer rides
+        :func:`~dlrover_tpu.parallel.reshaper.batched_device_put` —
+        every leaf's put is dispatched before any is waited on, with
+        ONE barrier at the end (the old per-tree put + block serialized
+        nothing across leaves through a multiplexing link) — the same
+        batched path the elastic in-process mesh reshape uses.
         """
         import jax
 
@@ -194,6 +198,7 @@ class ModelEngine:
             rules_for_mesh,
         )
         from dlrover_tpu.parallel.mesh import build_mesh
+        from dlrover_tpu.parallel.reshaper import batched_device_put
 
         spec = self.specs[name]
         axes = logical_axes if logical_axes is not None else (
@@ -206,10 +211,9 @@ class ModelEngine:
         target_sh = param_shardings_for(
             axes, mesh, rules_for_mesh(target_strategy.rules, mesh)
         )
-        t0 = time.perf_counter()
-        resharded = jax.device_put(self.params[name], target_sh)
-        resharded = jax.block_until_ready(resharded)
-        elapsed = time.perf_counter() - t0
+        resharded, elapsed = batched_device_put(
+            self.params[name], target_sh
+        )
         logger.info(
             "resharded %s into %s in %.3fs", name,
             target_strategy.describe(), elapsed,

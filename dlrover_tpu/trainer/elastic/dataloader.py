@@ -98,6 +98,14 @@ class ElasticDataLoader:
         with self._lock:
             self.batch_size = int(batch_size)
 
+    def reshape(self, num_replicas: int, rank: int):
+        """In-process membership change: re-shard the epoch remainder
+        over the new world (see :meth:`ElasticSampler.reshape`).  The
+        caller must re-enter ``iter(loader)`` — batches already yielded
+        were recorded as consumed, so the fresh iterator continues
+        exactly after them."""
+        self.sampler.reshape(num_replicas, rank)
+
     # -------------------------------------------------------------- iterate
 
     def __iter__(self):

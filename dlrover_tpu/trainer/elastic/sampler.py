@@ -80,6 +80,22 @@ class ElasticSampler:
         return (remaining + self.num_replicas - 1 - self.rank) // \
             self.num_replicas
 
+    # ------------------------------------------------------------- reshape
+
+    def reshape(self, num_replicas: int, rank: int):
+        """Re-shard the REMAINDER of the epoch over a new world — the
+        in-process membership-change path (no restart, no checkpoint
+        round-trip).  ``completed_num`` counts globally consumed
+        samples and consumption is a prefix of the epoch permutation,
+        so handing the tail to a different (num_replicas, rank) serves
+        every remaining sample exactly once and re-serves none."""
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(
+                f"rank {rank} out of range for {num_replicas} replicas"
+            )
+        self.num_replicas = int(num_replicas)
+        self.rank = int(rank)
+
     # ---------------------------------------------------------- consumption
 
     def record_batch(self, global_batch_size: int):

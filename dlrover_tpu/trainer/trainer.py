@@ -104,6 +104,8 @@ class Trainer:
         eval_fn: Optional[Callable] = None,
         optimizer=None,
         prestep: Optional[Callable] = None,
+        reshape_channel=None,
+        reshape_devices_fn: Optional[Callable] = None,
     ):
         self.args = args
         self.loss_fn = loss_fn
@@ -156,6 +158,28 @@ class Trainer:
             self._engine = ShardedCheckpointEngine(
                 os.path.join(args.output_dir, "checkpoints")
             )
+        # restart-free elasticity: when the agent exports a reshape
+        # channel (NodeEnv.RESHAPE_DIR) — or a test passes one — the
+        # train loop polls it at every step boundary and adopts
+        # membership changes IN PROCESS (mesh rebuild + device-to-
+        # device reshard) instead of being restarted.
+        self._reshape_channel = reshape_channel
+        self._reshape_devices_fn = reshape_devices_fn
+        self._reshape_round = -1
+        if self._reshape_channel is None:
+            from dlrover_tpu.common.constants import NodeEnv
+
+            rdir = os.environ.get(NodeEnv.RESHAPE_DIR, "")
+            if rdir:
+                from dlrover_tpu.trainer.elastic.reshape import (
+                    ReshapeChannel,
+                )
+
+                self._reshape_channel = ReshapeChannel(rdir)
+        if self._reshape_channel is not None:
+            # advertise the watcher: only now will the agent signal a
+            # reshape instead of restarting this worker
+            self._reshape_channel.mark_ready()
         self._timer = None
         try:
             from dlrover_tpu.trainer.timer import get_step_timer
@@ -312,88 +336,105 @@ class Trainer:
             if sampler is not None and hasattr(sampler, "set_epoch"):
                 if epoch != start_epoch:
                     sampler.set_epoch(epoch)
-            data_iter = iter(self.train_data)
-            while True:
-                # the host input pipeline's stall is a first-class
-                # diagnosis phase (data_wait vs compute vs ckpt blame):
-                # time the iterator pull into the shm ring
-                t_wait = time.time_ns()
-                try:
-                    batch = next(data_iter)
-                except StopIteration:
-                    break
-                wait_ns = time.time_ns() - t_wait
-                if self._timer is not None:
-                    self._timer.record(Tag.DATA_WAIT, t_wait, wait_ns)
-                if self._profiler is not None:
-                    self._profiler.maybe_start(self.global_step)
-                t0 = time.time_ns()
-                with tracing.span(
-                    "train.step", step=self.global_step + 1
-                ):
-                    rng = jax.random.fold_in(
-                        jax.random.key(args.seed), self.global_step
-                    )
-                    if self.prestep is not None:
-                        self.state, batch = self.prestep(
-                            self.state, batch
-                        )
-                    self.state, metrics = self._accel.train_step(
-                        self.state, batch, rng
-                    )
-                    self.global_step += 1
+            # reshaped=True re-enters iter(self.train_data) WITHOUT
+            # advancing the epoch: an in-process mesh reshape re-shards
+            # the epoch remainder over the new world, and consumption
+            # is recorded before each yield, so the fresh iterator
+            # continues exactly after the already-trained batches
+            reshaped = True
+            while reshaped and not stop:
+                reshaped = False
+                data_iter = iter(self.train_data)
+                while True:
+                    # drain-step boundary: adopt a pending membership
+                    # change (in-process mesh reshape) BETWEEN steps,
+                    # then restart the epoch iterator over the
+                    # re-sharded remainder
+                    if self._maybe_reshape():
+                        reshaped = True
+                        break
+                    # the host input pipeline's stall is a first-class
+                    # diagnosis phase (data_wait vs compute vs ckpt
+                    # blame): time the iterator pull into the shm ring
+                    t_wait = time.time_ns()
+                    try:
+                        batch = next(data_iter)
+                    except StopIteration:
+                        break
+                    wait_ns = time.time_ns() - t_wait
+                    if self._timer is not None:
+                        self._timer.record(Tag.DATA_WAIT, t_wait, wait_ns)
                     if self._profiler is not None:
-                        self._profiler.maybe_stop(
-                            self.global_step - 1, block_on=metrics
+                        self._profiler.maybe_start(self.global_step)
+                    t0 = time.time_ns()
+                    with tracing.span(
+                        "train.step", step=self.global_step + 1
+                    ):
+                        rng = jax.random.fold_in(
+                            jax.random.key(args.seed), self.global_step
                         )
-                dur_ns = time.time_ns() - t0
-                if self._timer is not None:
-                    self._timer.record(Tag.STEP, t0, dur_ns)
-                dur_s = dur_ns / 1e9
-                if self._compiled_once:
-                    telemetry.event(
-                        "step.end", step=self.global_step, dur=dur_s
-                    )
-                else:
-                    telemetry.event(
-                        "compile", step=self.global_step, dur=dur_s
-                    )
-                    self._compiled_once = True
-                telemetry.observe("train.step.seconds", dur_s)
-                if dur_s > 0:
-                    telemetry.gauge_set(
-                        "train.steps_per_s", 1.0 / dur_s
-                    )
-                    tokens = self._batch_tokens(batch)
-                    if tokens:
+                        if self.prestep is not None:
+                            self.state, batch = self.prestep(
+                                self.state, batch
+                            )
+                        self.state, metrics = self._accel.train_step(
+                            self.state, batch, rng
+                        )
+                        self.global_step += 1
+                        if self._profiler is not None:
+                            self._profiler.maybe_stop(
+                                self.global_step - 1, block_on=metrics
+                            )
+                    dur_ns = time.time_ns() - t0
+                    if self._timer is not None:
+                        self._timer.record(Tag.STEP, t0, dur_ns)
+                    dur_s = dur_ns / 1e9
+                    if self._compiled_once:
+                        telemetry.event(
+                            "step.end", step=self.global_step, dur=dur_s
+                        )
+                    else:
+                        telemetry.event(
+                            "compile", step=self.global_step, dur=dur_s
+                        )
+                        self._compiled_once = True
+                    telemetry.observe("train.step.seconds", dur_s)
+                    if dur_s > 0:
                         telemetry.gauge_set(
-                            "train.tokens_per_s", tokens / dur_s
+                            "train.steps_per_s", 1.0 / dur_s
                         )
-                if args.log_steps and \
-                        self.global_step % args.log_steps == 0:
-                    loss = float(metrics.get("loss", float("nan")))
-                    logger.info(
-                        "step %d epoch %d loss %.5f",
-                        self.global_step, epoch, loss,
-                    )
-                    telemetry.flush()
-                write_runtime_metrics(self.global_step)
-                if (
-                    self._engine is not None
-                    and args.save_steps
-                    and self.global_step % args.save_steps == 0
-                ):
-                    shm_saves += 1
-                    persist = (
-                        shm_saves % max(args.save_storage_every, 1) == 0
-                    )
-                    self.save_checkpoint(persist=persist)
-                if args.eval_steps and self.eval_data is not None and \
-                        self.global_step % args.eval_steps == 0:
-                    self.evaluate()
-                if args.max_steps and self.global_step >= args.max_steps:
-                    stop = True
-                    break
+                        tokens = self._batch_tokens(batch)
+                        if tokens:
+                            telemetry.gauge_set(
+                                "train.tokens_per_s", tokens / dur_s
+                            )
+                    if args.log_steps and \
+                            self.global_step % args.log_steps == 0:
+                        loss = float(metrics.get("loss", float("nan")))
+                        logger.info(
+                            "step %d epoch %d loss %.5f",
+                            self.global_step, epoch, loss,
+                        )
+                        telemetry.flush()
+                    write_runtime_metrics(self.global_step)
+                    if (
+                        self._engine is not None
+                        and args.save_steps
+                        and self.global_step % args.save_steps == 0
+                    ):
+                        shm_saves += 1
+                        persist = (
+                            shm_saves % max(args.save_storage_every, 1)
+                            == 0
+                        )
+                        self.save_checkpoint(persist=persist)
+                    if args.eval_steps and self.eval_data is not None \
+                            and self.global_step % args.eval_steps == 0:
+                        self.evaluate()
+                    if args.max_steps and \
+                            self.global_step >= args.max_steps:
+                        stop = True
+                        break
         if self._engine is not None:
             # The final checkpoint must not be lost to a cadence save's
             # persist still holding the shm lock: a silently skipped
@@ -454,6 +495,311 @@ class Trainer:
         except Exception:  # noqa: BLE001 - throughput gauge is garnish
             pass
         return 0
+
+    # ------------------------------------------- in-process mesh reshape
+
+    def _reshape_devices(self, req) -> list:
+        """The device set of the post-reshape mesh. Deployment hook:
+        ``reshape_devices_fn(req)`` decides (single-host tests emulate
+        scale events with local-device subsets); default is the
+        request's explicit ``device_count`` prefix, else every device
+        this process can see."""
+        import jax
+
+        if self._reshape_devices_fn is not None:
+            return list(self._reshape_devices_fn(req))
+        if req.device_count:
+            return list(jax.devices()[: req.device_count])
+        return list(jax.devices())
+
+    def _maybe_reshape(self) -> bool:
+        """Adopt a pending membership change IN PROCESS: rebuild the
+        mesh, reshard the live state device-to-device (checkpoint
+        fallback only for shards whose owners died), re-shard the
+        epoch remainder, and ack the agent. Returns True when a
+        reshape happened (the caller restarts its epoch iterator).
+        A failed reshape acks failure — the agent then falls back to
+        the classic restart path."""
+        if self._reshape_channel is None:
+            return False
+        req = self._reshape_channel.poll(self._reshape_round)
+        if req is None:
+            return False
+        t0 = time.monotonic()
+        ok, stats = False, {}
+        # transaction snapshot: _apply_reshape mutates accel/state/
+        # step/sampler in sequence, and a failure PAST any of those
+        # mutations (a chaos error at the resume seam, a bad rank in
+        # the data re-accounting) must not leave a half-adopted world
+        # behind a failed ack — training would continue on the new
+        # mesh with the OLD world's shard assignment until the agent's
+        # restart lands, double-serving data. Old jax arrays are
+        # immutable and not donated by the reshape, so restoring the
+        # references restores the world.
+        snap_accel, snap_state = self._accel, self.state
+        snap_step, snap_compiled = self.global_step, self._compiled_once
+        sampler = getattr(self.train_data, "sampler", None)
+        snap_sampler = (
+            (sampler.num_replicas, sampler.rank, sampler.state_dict())
+            if sampler is not None and hasattr(sampler, "state_dict")
+            else None
+        )
+        with tracing.span(
+            "elastic.reshape", round=req.round, step=self.global_step
+        ):
+            try:
+                stats = self._apply_reshape(req)
+                ok = True
+            except Exception as e:  # noqa: BLE001 - ANY failure here
+                # must surface as a failed ack so the agent falls back
+                # to the restart path instead of hanging on the ack
+                logger.exception(
+                    "in-process reshape for round %s failed; acking "
+                    "failure (the agent restarts this worker)",
+                    req.round,
+                )
+                stats = {"error": f"{type(e).__name__}: {e}"[:200]}
+                self._accel, self.state = snap_accel, snap_state
+                self.global_step = snap_step
+                self._compiled_once = snap_compiled
+                if snap_sampler is not None:
+                    sampler.num_replicas, sampler.rank = snap_sampler[:2]
+                    sampler.load_state_dict(snap_sampler[2])
+                # known gap: a stateful prestep hook overwritten by the
+                # in-process ROLLBACK's resume is not snapshotted here
+                # (host tiers can be GBs); the restart this failed ack
+                # triggers re-restores it from the step-matched sidecar
+        dur = time.monotonic() - t0
+        # ``step`` = the boundary the new mesh takes over at (post-
+        # rollback step on the rollback path): the agent/harness uses
+        # it to account the adoption against training progress
+        self._reshape_channel.ack(
+            req.round, ok, dur=dur, step=self.global_step, **stats
+        )
+        # consume the round even on failure: the agent's restart is
+        # the retry path, and re-polling the same request at every
+        # subsequent step boundary would re-run the reshape (and
+        # re-fire its chaos seams) against a state that moved on
+        self._reshape_round = req.round
+        if not ok:
+            return False
+        telemetry.event(
+            "elastic.reshape",
+            dur=dur,
+            round=req.round,
+            step=self.global_step,
+            shards_moved=stats.get("moved", 0),
+            shards_pulled=stats.get("pulled", 0),
+            rolled_back_to=stats.get("rolled_back_to", -1),
+        )
+        telemetry.observe("elastic.reshape.seconds", dur)
+        telemetry.counter_inc("elastic.reshape.count")
+        if stats.get("pulled"):
+            telemetry.counter_inc(
+                "elastic.reshape.shards_pulled", stats["pulled"]
+            )
+        telemetry.gauge_set("elastic.reshape.last_s", dur)
+        telemetry.flush()
+        logger.info(
+            "adopted round %s in process in %.3fs (world=%s, moved=%s "
+            "pulled=%s rolled_back_to=%s)",
+            req.round, dur, req.world, stats.get("moved"),
+            stats.get("pulled"), stats.get("rolled_back_to", -1),
+        )
+        return True
+
+    def _apply_reshape(self, req) -> dict:
+        import jax
+
+        from dlrover_tpu.parallel.accelerate import (
+            TrainState,
+            compute_state_shardings,
+            rules_for_mesh,
+        )
+        from dlrover_tpu.parallel.mesh import build_mesh
+        from dlrover_tpu.parallel.reshaper import (
+            reshape_pytree,
+            survivors_cover,
+        )
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            _tree_flatten_with_names,
+        )
+
+        chaos_point(
+            "elastic.reshape", verb="drain", step=self.global_step,
+            round=req.round,
+        )
+        devices = self._reshape_devices(req)
+        strategy = self._accel.strategy
+        mesh = build_mesh(strategy.mesh, devices=devices)
+        rules = rules_for_mesh(strategy.rules, mesh)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        param_sh, opt_sh = compute_state_shardings(
+            self.init_fn, self.optimizer, self.param_logical_axes,
+            mesh, rules, seed=self.args.seed,
+        )
+        state_sh = TrainState(
+            step=NamedSharding(mesh, PartitionSpec()),
+            params=param_sh,
+            opt_state=opt_sh,
+        )
+        # shards die with a DEAD host only; a drained host is alive at
+        # the drain point, so everything it holds is still readable
+        # device-to-device (the decision matrix in DESIGN.md)
+        lost_devices: set = set()
+        if any(
+            reason == "dead" for reason in (req.departed or {}).values()
+        ):
+            old_ids = {d.id for d in self._accel.mesh.devices.flat}
+            lost_devices = old_ids - {d.id for d in devices}
+        # checkpoint-engine leaf names for the fallback loader: the
+        # engine's own flatten of {"train": state} — the exact names
+        # its saved shards carry
+        names = _tree_flatten_with_names({"train": self.state})[0]
+        if lost_devices:
+            leaves = jax.tree_util.tree_leaves(self.state)
+            if any(
+                not survivors_cover(leaf, lost_devices)
+                for leaf in leaves
+            ):
+                # CONSISTENCY GATE: a lost shard can only come from a
+                # checkpoint, and a checkpoint older than the live
+                # step would mix steps inside one state. Exactly at
+                # the live step -> pull only the lost shards; older ->
+                # roll the WHOLE state back in process (still no
+                # process restart, no recompile of cached programs).
+                ckpt_step = (
+                    self._engine.latest_step()
+                    if self._engine is not None else -1
+                )
+                if ckpt_step < 0:
+                    raise ValueError(
+                        "shards lost with a dead host and no "
+                        "checkpoint exists — in-process reshape would "
+                        "lose state"
+                    )
+                if ckpt_step != self.global_step:
+                    return self._reshape_rollback(req, devices)
+        chaos_point(
+            "elastic.reshape", verb="reshard", step=self.global_step,
+            round=req.round,
+        )
+        new_state, report = reshape_pytree(
+            self.state,
+            state_sh,
+            lost_devices=lost_devices,
+            fallback=self._pull_lost_shards,
+            names=names,
+        )
+        self._adopt_accel(devices, new_state)
+        chaos_point(
+            "elastic.reshape", verb="resume", step=self.global_step,
+            round=req.round,
+        )
+        self._reshape_data(req)
+        return {
+            "moved": report.moved,
+            "pulled": report.pulled,
+            "move_s": round(report.move_seconds, 6),
+            "devices": len(devices),
+        }
+
+    def _pull_lost_shards(self, requests: dict) -> dict:
+        """Fallback loader for leaves whose only shards died with a
+        host: a TARGETED engine load keyed by checkpoint leaf names —
+        shard-wise, so each new device shard reads only the byte
+        ranges it needs from shm (preferred) or verified storage."""
+        if self._engine is None:
+            raise ValueError(
+                "lost shards but flash checkpointing is disabled"
+            )
+        result = self._engine.load(target=dict(requests))
+        if result is None:
+            raise ValueError(
+                f"lost shards {sorted(requests)[:3]} are not "
+                f"restorable from any checkpoint"
+            )
+        tree, step = result
+        if int(step) != self.global_step:
+            raise ValueError(
+                f"lost shards only restorable at step {step}, live "
+                f"state is at step {self.global_step} — mixing steps "
+                f"would corrupt the state"
+            )
+        return tree
+
+    def _reshape_rollback(self, req, devices) -> dict:
+        """Lost shards + no checkpoint at the live step: the whole
+        state returns to the newest restorable checkpoint, IN PROCESS
+        — fresh sharded init on the new mesh, then the standard
+        targeted resume (train state + dataloader progress + prestep
+        sidecar). Costs the replay since that step, but still no
+        process teardown and no cold recompile."""
+        import jax
+
+        logger.warning(
+            "reshape round %s: shards lost with a dead host and the "
+            "newest checkpoint predates the live step — rolling back "
+            "in process", req.round,
+        )
+        chaos_point(
+            "elastic.reshape", verb="reshard", step=self.global_step,
+            round=req.round,
+        )
+        self._adopt_accel(devices, None)
+        self.global_step = 0
+        resumed = self.maybe_resume()
+        chaos_point(
+            "elastic.reshape", verb="resume", step=self.global_step,
+            round=req.round,
+        )
+        self._reshape_data(req)
+        return {
+            "moved": 0,
+            "pulled": len(jax.tree_util.tree_leaves(self.state)),
+            "rolled_back_to": resumed,
+            "devices": len(devices),
+        }
+
+    def _adopt_accel(self, devices, state):
+        """Rebuild mesh + shardings + jitted step for the new device
+        set. ``state=None`` re-initializes (rollback path); otherwise
+        the resharded live state is adopted as-is. The first step on
+        the new mesh retraces — against the persistent XLA compilation
+        cache that is a cache replay, and it is charged to the
+        ``compile`` goodput bucket either way."""
+        from dlrover_tpu.parallel.accelerate import auto_accelerate
+
+        self._accel = auto_accelerate(
+            self.loss_fn,
+            self.init_fn,
+            self.optimizer,
+            self.param_logical_axes,
+            strategy=self._accel.strategy,
+            devices=devices,
+            seed=self.args.seed,
+            reuse_state=state,
+        )
+        self.state = self._accel.state if state is None else state
+        self._compiled_once = False
+
+    def _reshape_data(self, req):
+        """Exactly-once dataset re-accounting: re-shard the epoch
+        remainder over the new world. Loaders without a ``reshape``
+        hook (plain lists, master-served sharding clients — the
+        latter's exactly-once story lives in the master's dataset
+        manager) are left alone."""
+        if not hasattr(self.train_data, "reshape"):
+            return
+        from dlrover_tpu.common.constants import NodeEnv
+
+        local_rank = int(
+            os.environ.get(NodeEnv.LOCAL_RANK, "0") or 0
+        )
+        self.train_data.reshape(
+            max(int(req.total), 1), req.rank_offset + local_rank
+        )
 
     # --------------------------------------------------------- checkpoints
 

@@ -283,6 +283,328 @@ def _run_master_failover(schedule: dict, out_dir: str, steps: int) -> int:
     return rc
 
 
+RESHAPE_WORKER = """
+import json, os, time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from dlrover_tpu.trainer.trainer import Trainer, TrainingArgs
+from dlrover_tpu.trainer.elastic.dataloader import ElasticDataLoader
+from dlrover_tpu.trainer.elastic.reshape import ReshapeRequest
+from dlrover_tpu.trainer.elastic.sampler import ElasticSampler
+
+out_dir = os.environ["CHAOS_OUT_DIR"]
+mode = os.environ.get("CHAOS_FLAP_MODE", "elastic")
+inc = os.environ.get("CHAOS_INCARNATION", "0")
+devn = int(os.environ.get("CHAOS_DEVICE_COUNT", "4"))
+n_samples = int(os.environ.get("CHAOS_DATASET_SIZE", "96"))
+batch = 8
+
+rs = np.random.RandomState(0)
+w_true = rs.randn(8, 1).astype(np.float32)
+X = rs.randn(n_samples, 8).astype(np.float32)
+Y = (X @ w_true).astype(np.float32)
+
+# every sample fetch is logged (exactly-once accounting is asserted on
+# these lines) and paced so the harness can interleave scale events
+# with live training steps
+log = open(os.path.join(out_dir, f"consumed.{mode}.{inc}.jsonl"), "w")
+
+class DS:
+    def __len__(self):
+        return n_samples
+    def __getitem__(self, i):
+        log.write(f"{i}\\n")
+        log.flush()
+        time.sleep(0.02)
+        return (X[i], Y[i])
+
+def init_fn(rng):
+    return {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+
+def loss_fn(params, batch, rng):
+    x, y = batch
+    return jnp.mean((x @ params["w"] + params["b"] - y) ** 2)
+
+axes = {"w": ("embed", None), "b": (None,)}
+sampler = ElasticSampler(n_samples, num_replicas=1, rank=0, shuffle=False)
+loader = ElasticDataLoader(
+    DS(), batch_size=batch, sampler=sampler, config_file=""
+)
+args = TrainingArgs(
+    output_dir=os.path.join(out_dir, f"job_{mode}"),
+    micro_batch_size=batch, learning_rate=1e-2, log_steps=0,
+    optimizer="sgd", num_epochs=1,
+    # the elastic arm checkpoints every step so the mid-reshape kill
+    # loses zero steps; the controls replay steps, not restores
+    flash_checkpoint=(mode == "elastic"), save_steps=1,
+    save_storage_every=10**6,
+)
+trainer = Trainer(loss_fn, init_fn, axes, args, train_data=loader)
+trainer._adopt_accel(jax.devices()[:devn], None)
+
+if mode == "control":
+    # uninterrupted single process replaying the OBSERVED mesh schedule
+    # through direct in-process reshapes — no channel, no agent, no
+    # kill, no restart. Bit-identical finals prove the elasticity
+    # machinery (signal/drain/ack/kill/restart/restore) is transparent.
+    for i, (boundary, count) in enumerate(
+        json.loads(os.environ.get("CHAOS_FLAP_PLAN", "[]"))
+    ):
+        trainer.args.max_steps = int(boundary)
+        trainer.train()
+        trainer._apply_reshape(ReshapeRequest(
+            round=100 + i, world={0: 1}, total=1,
+            device_count=int(count),
+        ))
+    trainer.args.max_steps = 0
+
+trainer.train()
+params = jax.tree.map(np.asarray, trainer.state.params)
+np.savez(os.path.join(out_dir, f"params.{mode}.npz"), **params)
+with open(
+    os.path.join(out_dir, f"result.{mode}.{inc}.json"), "w"
+) as f:
+    json.dump({"final_step": trainer.global_step}, f)
+trainer.close()
+log.close()
+"""
+
+
+def _run_scale_flap(schedule: dict, out_dir: str, steps: int) -> int:
+    """Scale-flap harness: one live worker subprocess, the harness
+    playing the agent. Membership flaps (scale-in drain -> scale-out
+    adopt) are signaled into the live worker over the reshape channel
+    and must ride IN PROCESS; the armed schedule then kills the worker
+    mid-reshard on the third event, and recovery must take the classic
+    restart path. Asserted post-run: zero process restarts for the
+    surviving worker across the flap, exactly-once dataset sample
+    accounting across flap AND kill, a chaos-kill flight-recorder dump,
+    and a final train state BIT-IDENTICAL to an uninterrupted control
+    run replaying the same mesh schedule (plus allclose against a
+    never-reshaped baseline)."""
+    from dlrover_tpu.common.constants import NodeEnv
+
+    steps = max(steps, 12)
+    n_samples = steps * 8
+    reshape_dir = os.path.join(out_dir, "reshape_chan")
+    script = os.path.join(out_dir, "flap_worker.py")
+    with open(script, "w") as f:
+        f.write(RESHAPE_WORKER)
+
+    env_base = dict(os.environ)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    env_base["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env_base.get("PYTHONPATH")) if p
+    )
+    env_base["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8 "
+        "--xla_backend_optimization_level=0"
+    )
+    env_base["CHAOS_OUT_DIR"] = out_dir
+    env_base["CHAOS_DATASET_SIZE"] = str(n_samples)
+    env_base.setdefault(
+        "DLROVER_TELEMETRY_DIR", os.path.join(out_dir, "telemetry")
+    )
+
+    def spawn(mode: str, inc: int, devn: int, plan=None):
+        env = dict(env_base)
+        env["CHAOS_FLAP_MODE"] = mode
+        env["CHAOS_INCARNATION"] = str(inc)
+        env["CHAOS_DEVICE_COUNT"] = str(devn)
+        # separate shm/checkpoint namespaces per arm; the respawned
+        # elastic incarnation SHARES its predecessor's (that is the
+        # restart path's whole restore story)
+        env["ELASTIC_JOB_NAME"] = f"flap_{mode}_{os.getpid()}"
+        if mode == "elastic":
+            env[NodeEnv.RESHAPE_DIR] = reshape_dir
+        else:
+            env.pop(NodeEnv.RESHAPE_DIR, None)
+            env.pop("DLROVER_CHAOS", None)
+        if inc > 0:
+            # one-shot kill: a fresh incarnation re-arming the schedule
+            # would reset the rule counters and die again
+            env.pop("DLROVER_CHAOS", None)
+        if plan is not None:
+            env["CHAOS_FLAP_PLAN"] = json.dumps(plan)
+        log = open(os.path.join(out_dir, f"worker.{mode}.{inc}.log"), "ab")
+        return subprocess.Popen(  # noqa: S603
+            [sys.executable, script], env=env, stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+
+    def consumed(mode: str, inc: int) -> list[int]:
+        path = os.path.join(out_dir, f"consumed.{mode}.{inc}.jsonl")
+        try:
+            with open(path) as f:
+                return [int(line) for line in f if line.strip()]
+        except FileNotFoundError:
+            return []
+
+    def cleanup_shm():
+        # the killed incarnation cannot unlink its own segments; sweep
+        # every arm's job-scoped shm so repeated runs don't accumulate
+        from dlrover_tpu.common.ipc import PersistentSharedMemory
+
+        for mode in ("elastic", "control", "plain"):
+            job = f"flap_{mode}_{os.getpid()}"
+            for name in (
+                f"dlrtpu_ckpt_{job}_0", f"dlrtpu_timer_{job}",
+            ):
+                try:
+                    seg = PersistentSharedMemory(name=name)
+                    seg.close()
+                    seg.unlink()
+                except (FileNotFoundError, OSError):
+                    pass
+
+    try:
+        return _run_scale_flap_inner(
+            out_dir, steps, n_samples, reshape_dir, spawn, consumed,
+        )
+    finally:
+        cleanup_shm()
+
+
+def _run_scale_flap_inner(
+    out_dir, steps, n_samples, reshape_dir, spawn, consumed
+) -> int:
+    import numpy as np
+
+    from dlrover_tpu.common import flight
+    from dlrover_tpu.trainer.elastic.reshape import (
+        ReshapeChannel,
+        ReshapeRequest,
+    )
+
+    def wait_step(proc, inc: int, target: int, timeout: float = 180.0):
+        """Wait until the elastic worker has fetched ``target`` full
+        batches (== completed that many steps, fetch precedes step)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(consumed("elastic", inc)) >= target * 8:
+                return True
+            if proc.poll() is not None:
+                return False
+            time.sleep(0.05)
+        return False
+
+    def fail(msg: str) -> int:
+        print(f"FAIL: {msg}")
+        return 1
+
+    telemetry_dir = os.environ.get(
+        "DLROVER_TELEMETRY_DIR", os.path.join(out_dir, "telemetry")
+    )
+    channel = ReshapeChannel(reshape_dir)
+    channel.clear()
+    worker = spawn("elastic", 0, 4)
+    alive = lambda: worker.poll() is None  # noqa: E731
+
+    # --- flap: scale-in (drain) then scale-out (adopt), both in process
+    if not wait_step(worker, 0, max(steps // 4, 2)):
+        return fail("worker made no progress before the first flap")
+    channel.signal(ReshapeRequest(
+        round=2, world={0: 1}, total=1, device_count=2,
+        departed={1: "drained"},
+    ))
+    ack2 = channel.await_ack(2, timeout=120.0, alive_fn=alive)
+    if not (ack2 and ack2.get("ok")):
+        return fail(f"scale-in drain was not adopted in process: {ack2}")
+    channel.signal(ReshapeRequest(
+        round=3, world={0: 1}, total=1, device_count=4,
+    ))
+    ack3 = channel.await_ack(3, timeout=120.0, alive_fn=alive)
+    if not (ack3 and ack3.get("ok")):
+        return fail(f"scale-out was not adopted in process: {ack3}")
+    if not alive():
+        return fail("worker restarted during the flap (must be zero)")
+    print(
+        f"flap adopted in process with zero restarts: "
+        f"scale-in@step{ack2['step']} scale-out@step{ack3['step']}"
+    )
+
+    # --- third event: the armed schedule kills the worker mid-reshard
+    if not wait_step(worker, 0, int(ack3["step"]) + 2):
+        return fail("worker died or finished before the kill event")
+    channel.signal(ReshapeRequest(
+        round=4, world={0: 1}, total=1, device_count=2,
+        departed={1: "drained"},
+    ))
+    ack4 = channel.await_ack(4, timeout=120.0, alive_fn=alive)
+    if ack4 is not None:
+        return fail(f"round-4 reshape should have been killed: {ack4}")
+    rc = worker.wait(timeout=30)
+    if rc == 0:
+        return fail("worker exited clean; the mid-reshard kill never fired")
+    dumps = [
+        p for p in flight.list_dumps(telemetry_dir)
+        if "chaos-kill" in os.path.basename(p)
+    ]
+    if not dumps:
+        return fail("mid-reshape kill left no flight-recorder dump")
+    print(f"worker killed mid-reshard (rc={rc}); flight dump: {dumps[0]}")
+
+    # --- restart path: fresh incarnation on the round-4 world resumes
+    # from the flash checkpoint and finishes the epoch
+    channel.clear()
+    worker = spawn("elastic", 1, 2)
+    rc = worker.wait(timeout=300)
+    if rc != 0:
+        return fail(f"restarted worker failed rc={rc}")
+
+    inc0, inc1 = consumed("elastic", 0), consumed("elastic", 1)
+    if not inc1:
+        return fail("restarted worker consumed nothing")
+    # exactly-once accounting across flap AND kill: every sample
+    # served exactly once across both incarnations (save_steps=1, so
+    # the kill loses no step and the resume replays none)
+    served = sorted(inc0 + inc1)
+    if served != list(range(n_samples)):
+        extra = sorted(set(inc0) & set(inc1))
+        missing = sorted(set(range(n_samples)) - set(served))
+        return fail(
+            f"shard accounting not exactly-once: double-served="
+            f"{extra[:5]} lost={missing[:5]}"
+        )
+    resume_step = inc1[0] // 8
+    print(
+        f"exactly-once: {len(inc0)}+{len(inc1)} samples, restart "
+        f"resumed at step {resume_step}, 1 restart total (kill path)"
+    )
+
+    # --- controls: replay the observed mesh schedule uninterrupted
+    # (bit-identity), and a never-reshaped baseline (allclose)
+    plan = [
+        [int(ack2["step"]), 2], [int(ack3["step"]), 4],
+        [resume_step, 2],
+    ]
+    control = spawn("control", 0, 4, plan=plan)
+    plain = spawn("plain", 0, 4)
+    if control.wait(timeout=300) != 0 or plain.wait(timeout=300) != 0:
+        return fail("control run failed")
+    flap_p = np.load(os.path.join(out_dir, "params.elastic.npz"))
+    ctrl_p = np.load(os.path.join(out_dir, "params.control.npz"))
+    plain_p = np.load(os.path.join(out_dir, "params.plain.npz"))
+    for k in ctrl_p.files:
+        if not np.array_equal(flap_p[k], ctrl_p[k]):
+            return fail(
+                f"train state not bit-identical to the uninterrupted "
+                f"control at leaf {k!r}"
+            )
+        np.testing.assert_allclose(
+            flap_p[k], plain_p[k], rtol=1e-4, atol=1e-5,
+            err_msg=f"flap diverged from never-reshaped baseline at {k}",
+        )
+    print(
+        "final train state BIT-IDENTICAL to the uninterrupted control "
+        "(and allclose to the never-reshaped baseline)"
+    )
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -348,6 +670,13 @@ def main() -> int:
     ):
         # coordinator-loss harness: subprocess master + supervisor
         rc = _run_master_failover(schedule, out_dir, args.steps)
+    elif any(
+        str(r.get("site", "")).startswith("elastic.")
+        for r in schedule.get("rules", [])
+    ):
+        # membership-flap harness: live worker + harness-driven scale
+        # events over the reshape channel, restart only as fallback
+        rc = _run_scale_flap(schedule, out_dir, args.steps)
     else:
         rc = _run_in_process(out_dir)
 

@@ -60,6 +60,9 @@ def build_report(
     # raw snapshots are an input detail, not operator output
     report.pop("snapshots", None)
     report["restore"] = _restore_summary(report.get("metrics", {}))
+    report["reshape"] = _reshape_summary(
+        report.get("metrics", {}), report.get("ledger", {})
+    )
     report["control_plane"] = _control_plane_summary(
         report.get("metrics", {}), report.get("ledger", {})
     )
@@ -121,6 +124,24 @@ def _control_plane_summary(metrics: dict, ledger: dict) -> dict:
         out[f"rpc_{verb}_p99_ms"] = round(
             hist_quantile(bounds, counts, 0.99) * 1e3, 3
         )
+    return out
+
+
+def _reshape_summary(metrics: dict, ledger: dict) -> dict:
+    """In-process mesh reshapes (restart-free elasticity) at a glance:
+    the ledger's ``reshape`` bucket plus the per-event counters/gauges
+    the elastic trainer publishes (count, shards moved vs. pulled from
+    checkpoint, last event wall-clock)."""
+    out: dict = {}
+    for c in metrics.get("counters", ()):
+        if c["name"].startswith("elastic.reshape"):
+            out[c["name"]] = c["value"]
+    for g in metrics.get("gauges", ()):
+        if g["name"].startswith("elastic.reshape"):
+            out[g["name"]] = g["value"]
+    reshape_s = (ledger.get("categories") or {}).get("reshape", 0.0)
+    if reshape_s or out:
+        out["ledger_reshape_s"] = round(float(reshape_s), 3)
     return out
 
 
@@ -194,6 +215,11 @@ def main(argv=None) -> int:
             print("\n=== checkpoint data path ===")
             for name in sorted(restore):
                 print(f"{restore[name]:14.3f}  {name}")
+        reshape = report.get("reshape") or {}
+        if reshape:
+            print("\n=== elastic reshape (restart-free scale events) ===")
+            for name in sorted(reshape):
+                print(f"{reshape[name]:14.3f}  {name}")
         control = report.get("control_plane") or {}
         if control:
             print("\n=== control plane (master RPC surface) ===")
