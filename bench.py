@@ -411,14 +411,19 @@ def main():
     )
     _ = float(m["loss"])
 
+    from dlrover_tpu.common import mfu as mfu_mod
+
     params = sum(x.size for x in jax.tree.leaves(state.params))
-    model_flops = 6 * params * h_batch * seq + (
-        12 * headline_cfg.n_layers * headline_cfg.dim
-        * h_batch * seq * seq // 2
+    # ONE FLOPs/MFU definition shared with the trainer's live
+    # ``train.mfu`` gauge (common/mfu.py), so the offline headline and
+    # the live metrics plane cannot drift. Peak defaults to the bf16
+    # v5e figure: conservative for the int8 arm, whose dots run on the
+    # 2x int8 MXU path.
+    model_flops = mfu_mod.transformer_step_flops(
+        params, h_batch * seq, n_layers=headline_cfg.n_layers,
+        dim=headline_cfg.dim, seq=seq,
     )
-    # MFU against the bf16 peak (197 TFLOP/s v5e): conservative for the
-    # int8 arm, whose dots run on the 2x int8 MXU path
-    mfu = model_flops / step_time / 197e12 if on_tpu else 0.0
+    mfu = mfu_mod.mfu(model_flops, step_time) if on_tpu else 0.0
 
     # online per-kernel attribution (reference xpu_timer's named-kernel
     # Prometheus export): profile a short window on the SELECTED arm,
@@ -746,6 +751,16 @@ def main():
         restore_h2d_s = time.perf_counter() - t0
         del on_device
 
+        # the ROADMAP's sub-10s-restore headline: the full staged
+        # return trip after a preemption — host-side materialization
+        # (verified disk read, wall time; shm copy leg when the
+        # storage persist was skipped) plus the pipelined H2D leg.
+        # The individually-measured legs above stay the breakdown;
+        # this is the single number the target is driven against.
+        restore_total_s = (
+            restore_disk_s if restore_disk_s >= 0 else restore_shm_copy_s
+        ) + restore_h2d_s
+
         # in-process scale event (restart-free elasticity): rebuild the
         # mesh over half the devices and reshard the LIVE train state
         # onto it device-to-device via the generalized pytree reshaper
@@ -1005,6 +1020,9 @@ def main():
             "restore_disk_verify_s": round(restore_disk_verify_s, 3),
             "restore_h2d_s": round(restore_h2d_s, 3),
             "restore_h2d_mode": "pipelined-per-leaf",
+            # full preemption-restore wall clock (host leg + H2D): the
+            # <10 s north-star's single headline number
+            "restore_total_s": round(restore_total_s, 3),
             # in-process scale event (mesh rebuild + batched
             # device-to-device reshard of the live train state onto
             # half the devices) — what a restart-free membership

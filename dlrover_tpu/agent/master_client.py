@@ -322,6 +322,24 @@ class MasterClient:
         res: msg.TelemetryReport = self._get(msg.TelemetryReportRequest())
         return res.payload if res else {}
 
+    def query_metrics(
+        self,
+        name: str,
+        source: str = "",
+        resolution: str = "raw",
+        since: float = 0.0,
+        limit: int = 0,
+    ) -> list:
+        """Time series from the master's tiered metrics store (the
+        live metrics plane); see ``MetricsQueryRequest``."""
+        res: msg.MetricsSeries = self._get(
+            msg.MetricsQueryRequest(
+                name=name, source=source, resolution=resolution,
+                since=since, limit=limit,
+            )
+        )
+        return res.series if res else []
+
     def report_node_meta(
         self, node_rank: int, addr: str, tpu_chips: int = 0
     ) -> bool:

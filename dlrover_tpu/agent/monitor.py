@@ -214,6 +214,14 @@ class TelemetryReporter:
     Each tick also re-flushes the local snapshot so the on-disk copy
     used by ``tools/obs_report.py --dir`` stays fresh.
 
+    Shipping is DELTA-ENCODED: after a source's full snapshot was acked
+    once, later ticks send only what changed since that ack
+    (``telemetry.snapshot_delta``) — the wire and master-merge cost
+    scale with activity, not registry size. A rejected delta (master
+    failover lost our base, re-registration) drops the cursor so the
+    next tick re-sends the full snapshot; an unchanged registry sends
+    nothing at all.
+
     Best-effort like the other stats reporters: a NonCriticalGuard
     circuit breaker, never a training stall."""
 
@@ -232,12 +240,18 @@ class TelemetryReporter:
         )
         # source -> last shipped (mtime, size): only changed files go out
         self._shipped: dict = {}
+        # source -> last ACKED full snapshot (the delta base). Bounded
+        # by what this host itself produces (own registry + its
+        # workers' snapshot files).
+        self._acked: dict = {}
 
     def reset_shipped(self):
         """Forget what was shipped — after a master failover the new
         incarnation's merge may predate snapshots this host already
-        sent, so re-send everything on the next tick."""
+        sent, so re-send everything (FULL, not deltas against a base
+        the new master never saw) on the next tick."""
         self._shipped = {}
+        self._acked = {}
 
     def start(self):
         threading.Thread(
@@ -247,6 +261,33 @@ class TelemetryReporter:
     def stop(self):
         self._stopped.set()
 
+    def _ship(self, snap: dict) -> bool:
+        """Send one source's cumulative snapshot, delta-encoded when a
+        base was acked. Returns True when the master accepted it (the
+        acked base advances); a rejected/failed delta clears the base
+        so the next attempt is a full re-send."""
+        from dlrover_tpu.common import telemetry
+
+        source = snap.get("source")
+        base = self._acked.get(source)
+        payload = snap
+        if base is not None:
+            payload = telemetry.snapshot_delta(base, snap)
+            if not (
+                payload["counters"] or payload["gauges"]
+                or payload["histograms"] or payload["series"]
+                or payload["events"]
+            ):
+                return True  # nothing changed: keep the old base
+        ok = self._guard.run(
+            lambda: self._client.report_telemetry(payload)
+        )
+        if ok:
+            self._acked[source] = snap
+        elif base is not None:
+            self._acked.pop(source, None)
+        return bool(ok)
+
     def report_once(self, swallow: bool = False):
         from dlrover_tpu.common import telemetry
 
@@ -254,9 +295,7 @@ class TelemetryReporter:
             telemetry.flush()
             snap = telemetry.snapshot()
             if snap is not None:
-                self._guard.run(
-                    lambda: self._client.report_telemetry(snap)
-                )
+                self._ship(snap)
             own = snap["source"] if snap else None
             for path, source in self._snapshot_files(own):
                 try:
@@ -268,9 +307,7 @@ class TelemetryReporter:
                         payload = json.load(f)
                 except (OSError, ValueError):
                     continue  # torn write / vanished file: next tick
-                if self._guard.run(
-                    lambda p=payload: self._client.report_telemetry(p)
-                ):
+                if self._ship(payload):
                     self._shipped[source] = stamp
         except Exception:  # noqa: BLE001 - relaying telemetry must
             # never take the agent down — but a silently dead reporter

@@ -464,6 +464,9 @@ class DiagnosisResult(Message):
     stragglers: dict = field(default_factory=dict)
     # node_rank -> {"stalled_s": ..., "last_step": ...}
     hangs: dict = field(default_factory=dict)
+    # SLO watchdog breaches: "<rule>:<source>" -> {"rule": ..., ...}
+    # (step-time regression, goodput floor, MFU drop, events dropped)
+    slo: dict = field(default_factory=dict)
 
 
 # --------------------------------------------------------------------------
@@ -492,3 +495,26 @@ class TelemetryReport(Message):
     rollup, and the raw per-source snapshots (for client-side merges)."""
 
     payload: dict = field(default_factory=dict)
+
+
+@dataclass
+class MetricsQueryRequest(Message):
+    """Query the master's tiered metrics store (the live metrics
+    plane's history): one metric name across sources, at raw / 10 s /
+    1 min resolution. Serves ``obs_report --live`` sparklines without
+    re-shipping whole snapshots."""
+
+    name: str = ""
+    source: str = ""          # "" = every source
+    resolution: str = "raw"   # raw | 10s | 1m
+    since: float = 0.0        # wall-clock floor (0 = all retained)
+    limit: int = 0            # newest N points (0 = all retained)
+
+
+@dataclass
+class MetricsSeries(Message):
+    """Response: list of {source, name, labels, points}. Raw points
+    are [t, value]; downsampled points are
+    [t0, count, sum, min, max, last] per bucket."""
+
+    series: list = field(default_factory=list)

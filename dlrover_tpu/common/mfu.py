@@ -1,0 +1,55 @@
+"""Model-FLOPs utilization accounting — ONE definition shared by the
+trainer's per-step ``train.mfu`` gauge and the bench's offline
+``mfu_pct`` key, so the live and offline numbers cannot drift.
+
+The FLOPs model is the standard dense-transformer estimate: 6 FLOPs per
+parameter per token (fwd 2 + bwd 4) plus the causal-attention
+``QK^T``/``AV`` term ``12 * n_layers * dim * tokens * seq / 2`` that the
+parameter count does not capture. Models without the attention term
+(recsys, linear probes) use the dense part alone.
+
+Peak FLOP/s defaults to the v5e bf16 peak (197 TFLOP/s) and is
+env-overridable (``DLROVER_TPU_PEAK_FLOPS``) for other generations —
+deliberately conservative for int8-selected arms, whose dots run the
+2x int8 MXU path.
+"""
+
+from __future__ import annotations
+
+import os
+
+PEAK_FLOPS_ENV = "DLROVER_TPU_PEAK_FLOPS"
+# v5e bf16 peak per chip
+DEFAULT_PEAK_FLOPS = 197e12
+
+
+def peak_flops() -> float:
+    try:
+        return float(os.environ.get(PEAK_FLOPS_ENV, DEFAULT_PEAK_FLOPS))
+    except ValueError:
+        return DEFAULT_PEAK_FLOPS
+
+
+def transformer_step_flops(
+    params: int,
+    tokens: int,
+    n_layers: int = 0,
+    dim: int = 0,
+    seq: int = 0,
+) -> float:
+    """Model FLOPs of one train step over ``tokens`` tokens: dense
+    ``6 * params * tokens`` plus the causal attention score/value term
+    when the transformer shape is known (0s = dense-only estimate)."""
+    flops = 6.0 * params * tokens
+    if n_layers and dim and seq:
+        flops += 12.0 * n_layers * dim * tokens * seq / 2
+    return flops
+
+
+def mfu(flops_per_step: float, step_seconds: float,
+        peak: float | None = None) -> float:
+    """Fraction of peak the step achieved; 0 when unmeasurable."""
+    peak = peak_flops() if peak is None else peak
+    if step_seconds <= 0 or peak <= 0:
+        return 0.0
+    return flops_per_step / step_seconds / peak

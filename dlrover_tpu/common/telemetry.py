@@ -58,6 +58,10 @@ ENV_ROLE = "DLROVER_TELEMETRY_ROLE"  # worker | agent | master (labeling)
 
 SNAPSHOT_FORMAT = 1
 MAX_EVENTS = 4096
+# per-gauge time-series ring length: enough for a live dashboard's
+# recent-history sparkline at per-step cadence without letting a
+# thousand-gauge process grow its snapshot unboundedly
+SERIES_MAXLEN = 256
 
 # Latency-shaped defaults: sub-ms RPCs through multi-minute restores.
 DEFAULT_BUCKETS = (
@@ -199,6 +203,13 @@ class TelemetryRegistry:
         self._events: deque = deque(maxlen=MAX_EVENTS)
         self._dropped = 0
         self._seq = 0
+        # per-gauge time-series rings: every gauge_set appends a
+        # (sample_seq, wall, mono, value) point so consumers get recent
+        # HISTORY (sparklines, downsampling, SLO baselines), not just
+        # the latest value. sample_seq is the delta-shipping cursor —
+        # points above the last acked seq are the only ones re-sent.
+        self._series: dict[tuple, deque] = {}
+        self._sample_seq = 0
         self.created = time.time()
         self.created_mono = time.monotonic()
         self.role = os.environ.get(ENV_ROLE, "proc")
@@ -219,8 +230,17 @@ class TelemetryRegistry:
             self._counters[key] = self._counters.get(key, 0.0) + value
 
     def gauge_set(self, name: str, value: float, /, **labels):
+        key = _key(name, labels)
         with self._lock:
-            self._gauges[_key(name, labels)] = float(value)
+            self._gauges[key] = float(value)
+            ring = self._series.get(key)
+            if ring is None:
+                ring = self._series[key] = deque(maxlen=SERIES_MAXLEN)
+            self._sample_seq += 1
+            ring.append((
+                self._sample_seq, time.time(), time.monotonic(),
+                float(value),
+            ))
 
     def observe(self, name: str, value: float, /, buckets=None, **labels):
         key = _key(name, labels)
@@ -286,7 +306,7 @@ class TelemetryRegistry:
             "created": self.created,
             "now": time.time(),
             "counters": [], "gauges": [], "histograms": [],
-            "events": [], "events_dropped": self._dropped,
+            "series": [], "events": [], "events_dropped": self._dropped,
         }
 
     def _snapshot_locked(self) -> dict:
@@ -310,6 +330,16 @@ class TelemetryRegistry:
                 }
                 for (name, labels), h in sorted(self._hists.items())
             ],
+            "series": [
+                {
+                    "name": name,
+                    "labels": dict(labels),
+                    # [sample_seq, wall, mono, value] per point
+                    "points": [list(p) for p in ring],
+                }
+                for (name, labels), ring in sorted(self._series.items())
+            ],
+            "sample_seq": self._sample_seq,
             "events": [dict(e) for e in self._events],
             # no silent truncation: the ring is bounded, and a merge
             # must be able to tell "quiet" from "overwrote the tail"
@@ -581,6 +611,136 @@ def _sweep(intervals, lo: float, hi: float) -> dict:
 
 
 # -------------------------------------------------------------------------
+# delta-encoded shipping (agent -> master)
+# -------------------------------------------------------------------------
+#
+# Snapshots are cumulative, so a 1000-agent fleet re-sending its whole
+# registry every tick is O(fleet x registry) on the master. A delta
+# carries only what changed since the last ACKED snapshot: metrics as
+# full cumulative per-key values (per-key replacement is idempotent),
+# events above the acked seq, series points above the acked sample_seq.
+# The chain is integrity-checked by ``base_now``: a delta only applies
+# to the exact snapshot it was diffed against, so a master that lost
+# state (failover onto an older snapshot, restart from nothing) rejects
+# the delta and the sender falls back to one full re-send.
+
+
+def _metric_map(entries) -> dict:
+    return {_key(m["name"], m["labels"]): m for m in entries or ()}
+
+
+def _changed_metrics(base_entries, cur_entries, same) -> list:
+    base = _metric_map(base_entries)
+    out = []
+    for key, m in _metric_map(cur_entries).items():
+        prev = base.get(key)
+        if prev is None or not same(prev, m):
+            out.append(m)
+    return out
+
+
+def snapshot_delta(base: dict, cur: dict) -> dict:
+    """Diff two cumulative snapshots of the SAME source (``base`` the
+    last one the receiver acked, ``cur`` the fresh one) into a delta
+    payload ``apply_delta`` can merge. Registries are append-only, so
+    the diff is purely "new or changed" — keys never disappear."""
+    if base.get("source") != cur.get("source"):
+        raise ValueError(
+            f"delta across sources: {base.get('source')!r} vs "
+            f"{cur.get('source')!r}"
+        )
+    base_event_seq = max(
+        (e.get("seq", 0) for e in base.get("events") or ()), default=0
+    )
+    base_sample_seq = base.get("sample_seq", 0)
+    series = []
+    for s in cur.get("series") or ():
+        points = [p for p in s["points"] if p[0] > base_sample_seq]
+        if points:
+            series.append({
+                "name": s["name"], "labels": s["labels"],
+                "points": points,
+            })
+    return {
+        "format": cur.get("format", SNAPSHOT_FORMAT),
+        "source": cur["source"],
+        "role": cur.get("role"),
+        "pid": cur.get("pid"),
+        "created": cur.get("created"),
+        "now": cur.get("now"),
+        "delta": True,
+        "base_now": base.get("now"),
+        "counters": _changed_metrics(
+            base.get("counters"), cur.get("counters"),
+            lambda a, b: a["value"] == b["value"],
+        ),
+        "gauges": _changed_metrics(
+            base.get("gauges"), cur.get("gauges"),
+            lambda a, b: a["value"] == b["value"],
+        ),
+        "histograms": _changed_metrics(
+            base.get("histograms"), cur.get("histograms"),
+            lambda a, b: a["counts"] == b["counts"]
+            and a["sum"] == b["sum"],
+        ),
+        "series": series,
+        "sample_seq": cur.get("sample_seq", 0),
+        "events": [
+            e for e in cur.get("events") or ()
+            if e.get("seq", 0) > base_event_seq
+        ],
+        "events_dropped": cur.get("events_dropped", 0),
+    }
+
+
+def apply_delta(base: dict | None, delta: dict) -> dict | None:
+    """Merge a delta onto the held snapshot for its source. Returns the
+    merged cumulative snapshot, or None when the delta's base is not
+    what we hold (lost state / missed ack): the caller must reject it
+    so the sender re-sends a full snapshot.
+
+    The merged state is trimmed to the SAME bounds the source registry
+    enforces (MAX_EVENTS, SERIES_MAXLEN per key), which is what makes
+    delta shipping provably equivalent to full-snapshot shipping."""
+    if (
+        base is None
+        or base.get("source") != delta.get("source")
+        or base.get("now") != delta.get("base_now")
+    ):
+        return None
+    merged = dict(base)
+    for field in ("now", "pid", "sample_seq", "events_dropped"):
+        if field in delta:
+            merged[field] = delta[field]
+    merged.pop("delta", None)
+    merged.pop("base_now", None)
+    for section in ("counters", "gauges", "histograms"):
+        held = _metric_map(merged.get(section))
+        held.update(_metric_map(delta.get(section)))
+        merged[section] = [held[k] for k in sorted(held)]
+    held_series = {
+        _key(s["name"], s["labels"]): s
+        for s in merged.get("series") or ()
+    }
+    for s in delta.get("series") or ():
+        key = _key(s["name"], s["labels"])
+        prev = held_series.get(key)
+        points = (list(prev["points"]) if prev else []) + list(
+            s["points"]
+        )
+        held_series[key] = {
+            "name": s["name"], "labels": s["labels"],
+            "points": points[-SERIES_MAXLEN:],
+        }
+    merged["series"] = [held_series[k] for k in sorted(held_series)]
+    events = list(merged.get("events") or ()) + list(
+        delta.get("events") or ()
+    )
+    merged["events"] = events[-MAX_EVENTS:]
+    return merged
+
+
+# -------------------------------------------------------------------------
 # master-side merge (the job-wide view)
 # -------------------------------------------------------------------------
 
@@ -604,6 +764,15 @@ class JobTelemetry:
         source = str(snap["source"])
         with self._lock:
             existing = self._snaps.get(source)
+            if snap.get("delta"):
+                merged = apply_delta(existing, snap)
+                if merged is None:
+                    # base mismatch (we restarted, restored an older
+                    # snapshot, or never saw this source): refuse —
+                    # the False ack tells the sender to re-send full
+                    return False
+                self._snaps[source] = merged
+                return True
             if existing is not None and existing.get("now", 0.0) > snap.get(
                 "now", 0.0
             ):
@@ -628,6 +797,16 @@ class JobTelemetry:
 
     def ledger(self, now: float | None = None) -> dict:
         return goodput_ledger(self.snapshots(), now=now)
+
+    def events_dropped(self, snaps=None) -> dict:
+        """source -> events lost to its bounded ring (nonzero only).
+        Any entry here means that source's merged timeline is
+        INCOMPLETE — consumers must surface it loudly."""
+        return {
+            s["source"]: s.get("events_dropped", 0)
+            for s in (snaps if snaps is not None else self.snapshots())
+            if s.get("events_dropped", 0)
+        }
 
     def metrics_rollup(self, snaps=None) -> dict:
         """Counters summed across sources; gauges latest-source-wins;
@@ -691,6 +870,12 @@ class JobTelemetry:
             "ledger": goodput_ledger(snaps, now=now),
             "timeline": self.merged_events(snaps),
             "metrics": self.metrics_rollup(snaps),
+            # sources whose bounded event ring overwrote its tail: any
+            # nonzero entry means the merged timeline above is
+            # INCOMPLETE for that source, and consumers (obs_report,
+            # the SLO watchdog) must say so loudly rather than let a
+            # truncated timeline read as a complete one
+            "events_dropped": self.events_dropped(snaps),
             "snapshots": {s["source"]: s for s in snaps},
         }
 

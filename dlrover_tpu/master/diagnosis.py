@@ -113,9 +113,14 @@ class DiagnosisManager:
         hang_factor: float = HANG_FACTOR,
         hang_floor_s: float = HANG_FLOOR_S,
         check_interval: float = CHECK_INTERVAL,
+        slo_watchdog=None,
     ):
         self._telemetry = job_telemetry
         self._speed_monitor = speed_monitor
+        # the SLO watchdog (master/metrics_store.SloWatchdog) rides
+        # this manager's rate-limited sweep: breaches are a diagnosis
+        # verdict like stragglers/hangs, not a separate scanner thread
+        self.slo = slo_watchdog
         self._ratio = ratio
         self._zscore = zscore
         self._hang_factor = hang_factor
@@ -338,11 +343,22 @@ class DiagnosisManager:
                 return {
                     "stragglers": dict(self._stragglers),
                     "hangs": dict(self._hangs),
+                    "slo": (
+                        self.slo.breaches() if self.slo is not None
+                        else {}
+                    ),
                 }
             self._last_check = now
             snaps = self._telemetry.snapshots()
             stragglers = self.detect_stragglers(snaps)
             hangs = self.detect_hangs(now, snaps)
+            slo = {}
+            if self.slo is not None:
+                try:
+                    slo = self.slo.check(now)
+                except Exception:  # noqa: BLE001 - a watchdog bug must
+                    # not take straggler/hang detection down with it
+                    logger.exception("SLO watchdog sweep failed")
             for rank, info in stragglers.items():
                 if rank not in self._stragglers:
                     logger.warning(
@@ -370,6 +386,7 @@ class DiagnosisManager:
             return {
                 "stragglers": dict(stragglers),
                 "hangs": dict(hangs),
+                "slo": slo,
             }
 
     def stragglers(self) -> dict[int, dict]:

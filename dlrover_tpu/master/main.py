@@ -54,6 +54,13 @@ def parse_args(argv=None):
         help="write the bound host:port here (atomically); agents "
         "re-read it via DLROVER_MASTER_ADDR_FILE when reconnecting",
     )
+    parser.add_argument(
+        "--http-port", type=int,
+        default=int(os.environ.get("DLROVER_MASTER_HTTP_PORT", "-1")),
+        help="serve the read-only live-metrics HTTP plane (/metrics "
+        "Prometheus page, /report.json, /series.json, HTML dashboard "
+        "at /) on this port; 0 = ephemeral, -1 = disabled (default)",
+    )
     return parser.parse_args(argv)
 
 
@@ -94,9 +101,11 @@ def run(args) -> int:
         from dlrover_tpu.master.state_store import MasterStateStore
 
         port = MasterStateStore.peek_port(state_dir)
+    http_port = args.http_port if args.http_port >= 0 else None
     if args.platform == PlatformType.LOCAL:
         master = LocalJobMaster(
-            port, job_args, state_dir=state_dir, restore_state=restore
+            port, job_args, state_dir=state_dir, restore_state=restore,
+            http_port=http_port,
         )
     else:
         scaler = watcher = None
@@ -109,8 +118,16 @@ def run(args) -> int:
         master = DistributedJobMaster(
             port, job_args, scaler=scaler, watcher=watcher,
             state_dir=state_dir, restore_state=restore,
+            http_port=http_port,
         )
     master.prepare()
+    if master.http_plane is not None:
+        # discoverable like the RPC addr below: the dashboard/scrape
+        # target for whatever launched this master
+        print(
+            f"DLROVER_MASTER_HTTP=127.0.0.1:{master.http_plane.port}",
+            flush=True,
+        )
     addr = f"127.0.0.1:{master.port}"
     if args.addr_file:
         # the addr file is how agents re-resolve a restarted master

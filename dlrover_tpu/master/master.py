@@ -75,6 +75,17 @@ def _setup_state_store(master, state_dir, restore_state):
     return store, restored
 
 
+def _setup_http_plane(servicer, http_port):
+    """The read-only live-metrics HTTP thread (/metrics, /report.json,
+    /series.json, dashboard). ``None`` = disabled; ``0`` = ephemeral
+    port (``master.http_plane.port`` after prepare)."""
+    if http_port is None or http_port < 0:
+        return None
+    from dlrover_tpu.master.http_plane import MasterHttpPlane
+
+    return MasterHttpPlane(servicer, port=http_port)
+
+
 class LocalJobMaster(JobMaster):
     """Single-host master: task manager + rendezvous + kv-store served over
     the local control-plane port. Used by ``tpu-run`` when no cluster
@@ -83,6 +94,7 @@ class LocalJobMaster(JobMaster):
     def __init__(
         self, port: int, job_args=None,
         state_dir: str | None = None, restore_state: bool = False,
+        http_port: int | None = None,
     ):
         self._job_args = job_args
         self.task_manager = TaskManager()
@@ -110,6 +122,7 @@ class LocalJobMaster(JobMaster):
         self.state_store, self._restored = _setup_state_store(
             self, state_dir, restore_state
         )
+        self.http_plane = _setup_http_plane(self.servicer, http_port)
         self.paral_generator = ParalConfigGenerator(
             self.job_manager,
             self.task_manager.speed_monitor,
@@ -142,6 +155,8 @@ class LocalJobMaster(JobMaster):
             self.paral_generator.start()
         if self.state_store is not None:
             self.state_store.start()
+        if self.http_plane is not None:
+            self.http_plane.start()
         self._server.start()
         logger.info("LocalJobMaster serving on %s", self.addr)
 
@@ -188,6 +203,8 @@ class LocalJobMaster(JobMaster):
         self.job_manager.stop()
         if self.state_store is not None:
             self.state_store.stop()
+        if self.http_plane is not None:
+            self.http_plane.stop()
         self._server.stop()
         from dlrover_tpu.common import telemetry
 
@@ -202,6 +219,7 @@ class DistributedJobMaster(JobMaster):
     def __init__(
         self, port: int, job_args, scaler=None, watcher=None,
         state_dir: str | None = None, restore_state: bool = False,
+        http_port: int | None = None,
     ):
         self._job_args = job_args
         self.task_manager = TaskManager()
@@ -236,6 +254,7 @@ class DistributedJobMaster(JobMaster):
         self.state_store, self._restored = _setup_state_store(
             self, state_dir, restore_state
         )
+        self.http_plane = _setup_http_plane(self.servicer, http_port)
         # Dead nodes must leave rendezvous waiting sets and give their
         # in-flight shards back (code-review finding: these existed but
         # were never wired).
@@ -303,6 +322,8 @@ class DistributedJobMaster(JobMaster):
                 )
         if self.state_store is not None:
             self.state_store.start()
+        if self.http_plane is not None:
+            self.http_plane.start()
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
@@ -403,6 +424,8 @@ class DistributedJobMaster(JobMaster):
         self.job_manager.stop()
         if self.state_store is not None:
             self.state_store.stop()
+        if self.http_plane is not None:
+            self.http_plane.stop()
         self._server.stop()
         from dlrover_tpu.common import telemetry
 

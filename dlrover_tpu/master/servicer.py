@@ -145,17 +145,31 @@ class MasterServicer(RpcService):
         self.job_metric_collector = job_metric_collector
         self.elastic_ps_service = elastic_ps_service
         self.ckpt_barrier = CheckpointBarrierService()
-        # job-wide telemetry merge: agents ship registry snapshots, the
-        # report query serves the goodput ledger + merged timeline
+        # job-wide telemetry merge: agents ship registry snapshots
+        # (delta-encoded after the first ack), the report query serves
+        # the goodput ledger + merged timeline
         self.telemetry = JobTelemetry()
+        # live metrics plane: every shipped gauge's time-series points
+        # fold into the bounded tiered store (raw -> 10s -> 1min), the
+        # queryable history behind /series.json, MetricsQueryRequest
+        # and the SLO watchdog's rolling baselines
+        from dlrover_tpu.master.metrics_store import (
+            MetricsStore,
+            SloWatchdog,
+        )
+
+        self.metrics_store = MetricsStore()
         # runtime straggler/hang diagnosis over the merged telemetry
         # (per-host TimerRing phase gauges + step.end activity); checks
-        # are pull-driven from heartbeats and diagnosis queries
+        # are pull-driven from heartbeats and diagnosis queries. The
+        # SLO watchdog rides the same rate-limited sweep so breaches
+        # surface next to straggler/hang verdicts.
         from dlrover_tpu.master.diagnosis import DiagnosisManager
 
         self.diagnosis = DiagnosisManager(
             self.telemetry,
             speed_monitor=getattr(task_manager, "speed_monitor", None),
+            slo_watchdog=SloWatchdog(self.metrics_store, self.telemetry),
         )
         # durable control-plane state (master failover); set by the
         # owning JobMaster when a state dir is configured
@@ -252,6 +266,17 @@ class MasterServicer(RpcService):
             return msg.DiagnosisResult(
                 stragglers=verdicts["stragglers"],
                 hangs=verdicts["hangs"],
+                slo=verdicts.get("slo", {}),
+            )
+        if isinstance(message, msg.MetricsQueryRequest):
+            return msg.MetricsSeries(
+                series=self.metrics_store.query(
+                    message.name,
+                    source=message.source or None,
+                    resolution=message.resolution or "raw",
+                    since=message.since,
+                    limit=message.limit,
+                )
             )
         if isinstance(message, msg.KeyValueGetRequest):
             value = self.kv_store.get(message.key)
@@ -287,6 +312,7 @@ class MasterServicer(RpcService):
             local_snap = _telemetry.snapshot()
             if local_snap is not None:
                 self.telemetry.update(local_snap)
+                self.metrics_store.ingest_snapshot(local_snap)
             return msg.TelemetryReport(payload=self.telemetry.report())
         if isinstance(message, msg.ElasticRunConfigRequest):
             return msg.ElasticRunConfig(configs=dict(self._run_configs))
@@ -494,6 +520,10 @@ class MasterServicer(RpcService):
         if isinstance(message, msg.TelemetrySnapshot):
             ok = self.telemetry.update(message.payload)
             if ok:
+                # series points fold into the tiered store with
+                # sample-seq dedup, so re-sent snapshots are as
+                # idempotent here as in the merge above
+                self.metrics_store.ingest_snapshot(message.payload)
                 self._mark_dirty()
             return ok
         if isinstance(message, msg.DiagnosisReport):

@@ -223,6 +223,14 @@ class MasterStateStore:
             )
             state["run_configs"] = dict(self._servicer._run_configs)
             state["telemetry"] = self._servicer.telemetry.snapshots()
+            # the live metrics plane's history (tiered series + dedup
+            # high-water marks): a restarted master resumes with its
+            # sparklines/SLO baselines intact, and the preserved
+            # last-sseq marks make post-failover full re-sends land
+            # idempotently
+            state["metrics_store"] = (
+                self._servicer.metrics_store.export_state()
+            )
         return state
 
     def write_snapshot(self) -> str | None:
@@ -334,6 +342,10 @@ class MasterStateStore:
                 self._servicer.set_run_configs(state["run_configs"])
             for snap in state.get("telemetry") or ():
                 self._servicer.telemetry.update(snap)
+            if state.get("metrics_store"):
+                self._servicer.metrics_store.restore_state(
+                    state["metrics_store"]
+                )
 
     def _apply_wal_entry(self, e: dict, snapshot_applied: bool = True):
         op = e.get("op")

@@ -55,6 +55,11 @@ class TrainingArgs:
     profile: bool = False
     profile_start_step: int = 10
     profile_num_steps: int = 3
+    # model FLOPs per TOKEN for the live ``train.mfu`` gauge. 0 = the
+    # dense estimate 6 * param_count; transformer callers pass the
+    # exact value (common/mfu.transformer_step_flops(...) / tokens) so
+    # the live gauge and bench's offline mfu_pct agree by construction
+    model_flops_per_token: float = 0.0
 
 
 def _build_optimizer(args: TrainingArgs):
@@ -146,6 +151,12 @@ class Trainer:
         # first train_step of this process incarnation traces+compiles;
         # its wall time is attributed to the "compile" goodput category
         self._compiled_once = False
+        # live MFU/HBM accounting: FLOPs-per-token computed once per
+        # (re)shape (_refresh_flops re-runs in _adopt_accel), device
+        # memory_stats availability probed once on first use
+        self._flops_per_token = 0.0
+        self._device_mem_ok: bool | None = None
+        self._refresh_flops()
         # step the on-disk pending/latest prestep sidecar was last
         # serialized at (skip-rewrite cache; None = dirty)
         self._prestep_sidecar_step = None
@@ -389,7 +400,8 @@ class Trainer:
                     if self._timer is not None:
                         self._timer.record(Tag.STEP, t0, dur_ns)
                     dur_s = dur_ns / 1e9
-                    if self._compiled_once:
+                    steady = self._compiled_once
+                    if steady:
                         telemetry.event(
                             "step.end", step=self.global_step, dur=dur_s
                         )
@@ -408,6 +420,25 @@ class Trainer:
                             telemetry.gauge_set(
                                 "train.tokens_per_s", tokens / dur_s
                             )
+                        # steady-state only: the compile step's wall
+                        # time is not a step-time/MFU sample, and one
+                        # giant first point would poison the SLO
+                        # watchdog's rolling baselines
+                        if steady:
+                            telemetry.gauge_set(
+                                "train.step.last_s", dur_s
+                            )
+                            if tokens and self._flops_per_token > 0:
+                                from dlrover_tpu.common import mfu
+
+                                telemetry.gauge_set(
+                                    "train.mfu",
+                                    mfu.mfu(
+                                        self._flops_per_token * tokens,
+                                        dur_s,
+                                    ),
+                                )
+                    self._emit_device_gauges()
                     if args.log_steps and \
                             self.global_step % args.log_steps == 0:
                         loss = float(metrics.get("loss", float("nan")))
@@ -472,6 +503,104 @@ class Trainer:
                 )
         telemetry.flush()
         return self.state, metrics
+
+    # ------------------------------------------- live MFU / HBM gauges
+
+    def _refresh_flops(self):
+        """Model FLOPs per token, computed once per (re)shape — never
+        in the step loop. Explicit ``model_flops_per_token`` wins
+        (transformers pass the exact attention-inclusive value via
+        common/mfu); the fallback is the dense 6 * params estimate."""
+        if self.args.model_flops_per_token > 0:
+            self._flops_per_token = float(
+                self.args.model_flops_per_token
+            )
+            return
+        try:
+            import jax
+
+            params = sum(
+                x.size
+                for x in jax.tree_util.tree_leaves(self.state.params)
+            )
+            self._flops_per_token = 6.0 * params
+        except Exception:  # noqa: BLE001 - a non-standard state tree
+            # just loses the MFU gauge, never the training loop
+            self._flops_per_token = 0.0
+        # compile-cache stats ride the same once-per-(re)shape cadence:
+        # a reshape's re-jit is a cache replay, and the gauge pair
+        # shows whether the persistent cache is actually being reused
+        self._emit_compile_cache_gauges()
+
+    def _emit_compile_cache_gauges(self):
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
+        if not cache_dir:
+            try:
+                import jax
+
+                cache_dir = (
+                    jax.config.jax_compilation_cache_dir or ""
+                )
+            except Exception:  # noqa: BLE001 - knob absent in old jax
+                cache_dir = ""
+        if not cache_dir or not os.path.isdir(cache_dir):
+            return
+        entries = size = 0
+        try:
+            with os.scandir(cache_dir) as it:
+                for de in it:
+                    if de.is_file():
+                        entries += 1
+                        size += de.stat().st_size
+        except OSError:
+            return
+        telemetry.gauge_set("compile.cache.entries", entries)
+        telemetry.gauge_set("compile.cache.bytes", size)
+
+    def _emit_device_gauges(self):
+        """Per-device HBM gauges from ``device.memory_stats()`` where
+        the backend provides them, plus host-arena occupancy. The
+        device half is probed once — a backend without memory_stats
+        costs one branch per step thereafter; the arena gauge is
+        host-side and emits regardless."""
+        if self._device_mem_ok is not False:
+            try:
+                import jax
+
+                reported = False
+                for i, dev in enumerate(jax.local_devices()):
+                    mem = getattr(dev, "memory_stats", None)
+                    m = mem() if callable(mem) else None
+                    if not m:
+                        continue
+                    reported = True
+                    telemetry.gauge_set(
+                        "device.hbm.live_bytes",
+                        m.get("bytes_in_use", 0), device=str(i),
+                    )
+                    if "peak_bytes_in_use" in m:
+                        telemetry.gauge_set(
+                            "device.hbm.peak_bytes",
+                            m["peak_bytes_in_use"], device=str(i),
+                        )
+                    if "bytes_limit" in m:
+                        telemetry.gauge_set(
+                            "device.hbm.limit_bytes",
+                            m["bytes_limit"], device=str(i),
+                        )
+                if self._device_mem_ok is None:
+                    self._device_mem_ok = reported
+            except Exception:  # noqa: BLE001 - gauges are garnish
+                self._device_mem_ok = False
+        try:
+            from dlrover_tpu.common.arena import get_arena
+
+            telemetry.gauge_set(
+                "ckpt.arena.pooled_bytes",
+                get_arena().stats()["pooled_bytes"],
+            )
+        except Exception:  # noqa: BLE001
+            pass
 
     @staticmethod
     def _batch_tokens(batch) -> int:
@@ -783,6 +912,8 @@ class Trainer:
         )
         self.state = self._accel.state if state is None else state
         self._compiled_once = False
+        # model FLOPs are a per-(re)shape constant, not a per-step one
+        self._refresh_flops()
 
     def _reshape_data(self, req):
         """Exactly-once dataset re-accounting: re-shard the epoch

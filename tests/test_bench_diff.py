@@ -1,0 +1,109 @@
+"""tools/bench_diff.py: headline-key regression gate between two bench
+result files — direction-aware thresholds, sentinel skipping, CLI exit
+codes (pre-commit/CI contract, like tools/lint.py's)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.bench_diff import diff_benches  # noqa: E402
+
+pytestmark = pytest.mark.metrics
+
+
+def _payload(**detail):
+    value = detail.pop("value", 95.0)
+    return {"metric": "goodput", "value": value, "detail": detail}
+
+
+class TestDiffBenches:
+    def test_direction_aware_regressions(self):
+        old = _payload(step_time_ms=100.0, tokens_per_sec=1000.0,
+                       restore_total_s=10.0)
+        new = _payload(step_time_ms=120.0, tokens_per_sec=1000.0,
+                       restore_total_s=8.0)
+        result = diff_benches(old, new, threshold_pct=10.0)
+        assert [r["key"] for r in result["regressions"]] == [
+            "step_time_ms"
+        ]
+        assert [r["key"] for r in result["improvements"]] == [
+            "restore_total_s"
+        ]
+        # higher-is-better direction: a DROP is the regression
+        result = diff_benches(
+            _payload(tokens_per_sec=1000.0),
+            _payload(tokens_per_sec=800.0),
+        )
+        assert [r["key"] for r in result["regressions"]] == [
+            "tokens_per_sec"
+        ]
+
+    def test_threshold_boundary(self):
+        old = _payload(step_time_ms=100.0)
+        new = _payload(step_time_ms=109.9)
+        assert diff_benches(old, new, 10.0)["regressions"] == []
+        new = _payload(step_time_ms=110.1)
+        assert len(diff_benches(old, new, 10.0)["regressions"]) == 1
+
+    def test_sentinels_and_missing_keys_skipped(self):
+        """-1 (skipped arm), 0 (off-TPU mfu), and absent keys must not
+        be priced as regressions."""
+        old = _payload(restore_total_s=-1.0, mfu_pct=68.0,
+                       reshape_s=2.0)
+        new = _payload(restore_total_s=500.0, mfu_pct=0.0)
+        result = diff_benches(old, new)
+        assert result["regressions"] == []
+        # only "value" (present+positive in both) was comparable
+        assert result["compared"] == 1
+
+    def test_driver_envelope_unwrapped(self):
+        old = {"n": 1, "parsed": _payload(step_time_ms=100.0)}
+        new = {"n": 2, "parsed": _payload(step_time_ms=200.0)}
+        (reg,) = diff_benches(old, new)["regressions"]
+        assert reg["key"] == "step_time_ms"
+        assert reg["change_pct"] == pytest.approx(100.0)
+
+
+class TestCli:
+    def _run(self, tmp_path, old, new, *args):
+        a, b = tmp_path / "old.json", tmp_path / "new.json"
+        a.write_text(json.dumps(old))
+        b.write_text(json.dumps(new))
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             str(a), str(b), *args],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_exit_codes(self, tmp_path):
+        clean = self._run(
+            tmp_path, _payload(step_time_ms=100.0),
+            _payload(step_time_ms=101.0),
+        )
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        bad = self._run(
+            tmp_path, _payload(step_time_ms=100.0),
+            _payload(step_time_ms=150.0),
+        )
+        assert bad.returncode == 1
+        assert "REGRESSION" in bad.stdout and "step_time_ms" in bad.stdout
+        empty = self._run(tmp_path, {"detail": {}}, {"detail": {}})
+        assert empty.returncode == 2
+
+    def test_json_output_and_custom_threshold(self, tmp_path):
+        proc = self._run(
+            tmp_path, _payload(step_time_ms=100.0),
+            _payload(step_time_ms=150.0), "--threshold", "60",
+            "--json",
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload["regressions"] == []
+        assert payload["threshold_pct"] == 60.0

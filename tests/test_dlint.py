@@ -659,6 +659,114 @@ class TestMessageDrift:
         assert found == []
 
 
+# ---------------------------------------------------------------- DL007
+
+
+class TestMetricDrift:
+    """Metric-name drift: names the operator surfaces QUERY must be
+    EMITTED somewhere in the package (the DL006 idea applied to
+    telemetry names)."""
+
+    EMITTER = """
+        from dlrover_tpu.common import telemetry
+
+        def instrument():
+            telemetry.gauge_set("ckpt.restore.read_gbps", 1.0)
+            telemetry.counter_inc("live.metric")
+            telemetry.observe("rpc.seconds", 0.1)
+            telemetry.event("step.end", dur=0.1)
+    """
+
+    def _tree(self, tmp_path, consumer, emitter=None, **kw):
+        pkg = tmp_path / "dlrover_tpu"
+        pkg.mkdir(exist_ok=True)
+        (pkg / "emit.py").write_text(
+            textwrap.dedent(emitter or self.EMITTER)
+        )
+        tools = tmp_path / "tools"
+        tools.mkdir(exist_ok=True)
+        (tools / "obs_report.py").write_text(textwrap.dedent(consumer))
+        return run_checks(
+            [str(pkg), str(tools)], repo_root=str(tmp_path),
+            checkers=["metric-drift"], **kw,
+        )
+
+    CONSUMER_MIXED = """
+        def summary(metrics):
+            out = {}
+            for g in metrics["gauges"]:
+                if g["name"] == "live.metric":
+                    out[g["name"]] = g["value"]
+                if g["name"] == "ghost.metric":
+                    out[g["name"]] = g["value"]
+                if g["name"].startswith(("ckpt.restore.", "ghost.")):
+                    out[g["name"]] = g["value"]
+            return out
+    """
+
+    def test_dead_query_and_prefix_flagged_live_pass(self, tmp_path):
+        found = self._tree(tmp_path, self.CONSUMER_MIXED)
+        details = sorted(f.detail for f in found)
+        assert details == ["name|ghost.metric", "prefix|ghost."], details
+        assert all(f.code == "DL007" for f in found)
+
+    def test_event_kinds_count_as_emitted(self, tmp_path):
+        found = self._tree(tmp_path, """
+            def summary(timeline):
+                return [e for e in timeline
+                        if e["name"] == "step.end"]
+        """)
+        assert found == []
+
+    def test_allow_hatch(self, tmp_path):
+        found = self._tree(tmp_path, """
+            def summary(metrics):
+                return [
+                    g for g in metrics
+                    # dlint: allow-metric-drift(emitted w/ computed name)
+                    if g["name"] == "dyn.metric"
+                ]
+        """)
+        assert found == []
+
+    def test_partial_scope_without_consumer_is_silent(self, tmp_path):
+        """Only the package in scope: nothing queries, nothing to
+        check (and no spurious dead-name findings)."""
+        pkg = tmp_path / "dlrover_tpu"
+        pkg.mkdir()
+        (pkg / "emit.py").write_text(textwrap.dedent(self.EMITTER))
+        assert run_checks(
+            [str(pkg)], repo_root=str(tmp_path),
+            checkers=["metric-drift"],
+        ) == []
+
+    def test_partial_scope_without_package_is_silent(self, tmp_path):
+        """Only the consumer in scope (pre-commit on tools/): every
+        queried name would look dead — the checker must skip."""
+        tools = tmp_path / "tools"
+        tools.mkdir()
+        (tools / "obs_report.py").write_text(
+            textwrap.dedent(self.CONSUMER_MIXED)
+        )
+        assert run_checks(
+            [str(tools)], repo_root=str(tmp_path),
+            checkers=["metric-drift"],
+        ) == []
+
+    def test_baseline_entry_path(self, tmp_path):
+        """A justified false positive (e.g. a name emitted only with a
+        computed first arg) can ride the baseline like every other
+        checker's findings — and the fingerprint is line-stable."""
+        found = self._tree(tmp_path, self.CONSUMER_MIXED)
+        bl = Baseline(path=str(tmp_path / "baseline.json"))
+        bl.update(found, note="emitted via variable name table")
+        bl.save()
+        bl = Baseline.load(str(tmp_path / "baseline.json"))
+        new, stale = bl.diff(found)
+        assert new == [] and stale == []
+        assert bl.unjustified() == []
+
+
 # -------------------------------------------------- escape-hatch parsing
 
 
@@ -847,7 +955,8 @@ class TestRepoGate:
         t0 = time.monotonic()
         findings = run_checks(
             [os.path.join(REPO_ROOT, "dlrover_tpu"),
-             os.path.join(REPO_ROOT, "tools")],
+             os.path.join(REPO_ROOT, "tools"),
+             os.path.join(REPO_ROOT, "bench.py")],
             repo_root=REPO_ROOT,
         )
         elapsed = time.monotonic() - t0
@@ -867,7 +976,8 @@ class TestRepoGate:
         finding — stale entries mean fixed code, prune them."""
         findings = run_checks(
             [os.path.join(REPO_ROOT, "dlrover_tpu"),
-             os.path.join(REPO_ROOT, "tools")],
+             os.path.join(REPO_ROOT, "tools"),
+             os.path.join(REPO_ROOT, "bench.py")],
             repo_root=REPO_ROOT,
         )
         bl = Baseline.load(

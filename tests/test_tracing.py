@@ -267,6 +267,34 @@ class TestQuantiles:
 
         assert math.isnan(hist_quantile([1.0], [0, 0], 0.5))
 
+    def test_degenerate_inputs(self):
+        """The operator-facing quantile must stay finite and bounded on
+        every degenerate shape: empty counts list, no bounds at all,
+        every observation past the last finite bound, and the q=0/q=1
+        edges (clamped, never extrapolated)."""
+        import math
+
+        # empty/zero counts and empty bounds: NaN, never a crash
+        assert math.isnan(hist_quantile([1.0, 2.0], [], 0.5))
+        assert math.isnan(hist_quantile([], [], 0.5))
+        assert math.isnan(hist_quantile([], [5], 0.5))  # no finite bound
+        # EVERYTHING in the +Inf overflow bucket: every quantile clamps
+        # to the last finite bound (there is no upper edge to
+        # interpolate toward)
+        for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+            assert hist_quantile([1.0, 4.0], [0, 0, 7], q) == 4.0
+        # q=0 -> the LOWER edge of the first nonempty bucket; q=1 ->
+        # the upper edge of the last nonempty one
+        assert hist_quantile(
+            [1.0, 2.0, 4.0], [0, 5, 0, 0], 0.0
+        ) == pytest.approx(1.0)
+        assert hist_quantile(
+            [1.0, 2.0, 4.0], [0, 5, 0, 0], 1.0
+        ) == pytest.approx(2.0)
+        # out-of-range q is clamped into [0, 1], not extrapolated
+        assert hist_quantile([1.0], [10, 0], -0.5) == pytest.approx(0.0)
+        assert hist_quantile([1.0], [10, 0], 2.0) == pytest.approx(1.0)
+
     def test_sum_bucket_counts_merges_and_skips_mismatched(self):
         from dlrover_tpu.common.telemetry import sum_bucket_counts
 

@@ -1,0 +1,146 @@
+"""Diff two bench result files (``BENCH_r*.json``) and flag headline
+regressions — pre-commit/CI-ready like ``tools/lint.py``.
+
+Usage:
+    python tools/bench_diff.py OLD.json NEW.json [--threshold 10] [--json]
+
+Each headline key carries a direction (lower-better vs higher-better);
+a key that moved in the WORSE direction by more than ``--threshold``
+percent is a regression and the tool exits 1 (0 = clean, 2 = unusable
+inputs). Sentinel values (<= 0: skipped arms publish -1/0) and keys
+missing from either file are ignored — an arm that stopped running is
+a bench-content question, not a perf regression this tool can price.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# headline keys -> the direction that is BETTER. Kept to the keys the
+# ROADMAP/README treat as headline numbers; noisy micro-keys (minmax
+# spreads, per-op lists) are deliberately absent.
+HEADLINE_KEYS = {
+    "value": "higher",                 # goodput % (the top-level metric)
+    "step_time_ms": "lower",
+    "tokens_per_sec": "higher",
+    "mfu_pct": "higher",
+    "nano_step_time_ms": "lower",
+    "opt_step_ms": "lower",
+    "opt_fused_step_ms": "lower",
+    "ckpt_blocking_pause_s": "lower",
+    "ckpt_engine_gbps": "higher",
+    "ckpt_shm_fill_gbps": "higher",
+    "ckpt_shm_scatter_gbps": "higher",
+    "restore_total_s": "lower",
+    "restore_disk_s": "lower",
+    "restore_h2d_s": "lower",
+    "restore_shm_headline_copy_s": "lower",
+    "reshape_s": "lower",
+    "master_rpc_p99_ms": "lower",
+    "joins_per_sec": "higher",
+}
+
+
+def _flatten(payload: dict) -> dict:
+    """Top-level ``value`` + every ``detail`` key, one flat namespace.
+    Accepts both the raw bench stdout payload and the driver's
+    ``BENCH_r*.json`` envelope (payload under ``parsed``)."""
+    if isinstance(payload.get("parsed"), dict):
+        payload = payload["parsed"]
+    out = {}
+    if isinstance(payload.get("value"), (int, float)):
+        out["value"] = float(payload["value"])
+    for key, val in (payload.get("detail") or {}).items():
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[key] = float(val)
+    return out
+
+
+def diff_benches(
+    old: dict, new: dict, threshold_pct: float = 10.0,
+) -> dict:
+    """-> {"regressions": [...], "improvements": [...], "compared": n}.
+
+    Each entry: {key, old, new, change_pct, direction}; ``change_pct``
+    is signed in the metric's own units (positive = value went up)."""
+    old_flat, new_flat = _flatten(old), _flatten(new)
+    regressions, improvements = [], []
+    compared = 0
+    for key, direction in HEADLINE_KEYS.items():
+        a, b = old_flat.get(key), new_flat.get(key)
+        if a is None or b is None or a <= 0 or b <= 0:
+            continue  # sentinel / skipped arm / absent key
+        compared += 1
+        change_pct = (b / a - 1.0) * 100
+        worse = change_pct > 0 if direction == "lower" else change_pct < 0
+        entry = {
+            "key": key,
+            "old": a,
+            "new": b,
+            "change_pct": round(change_pct, 2),
+            "direction": direction,
+        }
+        if worse and abs(change_pct) > threshold_pct:
+            regressions.append(entry)
+        elif not worse and abs(change_pct) > threshold_pct:
+            improvements.append(entry)
+    return {
+        "regressions": regressions,
+        "improvements": improvements,
+        "compared": compared,
+        "threshold_pct": threshold_pct,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("old", help="baseline bench JSON")
+    parser.add_argument("new", help="candidate bench JSON")
+    parser.add_argument(
+        "--threshold", type=float, default=10.0,
+        help="worse-direction change above this percent fails (default "
+        "10)",
+    )
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    try:
+        with open(args.old) as f:
+            old = json.load(f)
+        with open(args.new) as f:
+            new = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: unreadable input: {e}", file=sys.stderr)
+        return 2
+    result = diff_benches(old, new, threshold_pct=args.threshold)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        if result["compared"] == 0:
+            print("bench_diff: no comparable headline keys",
+                  file=sys.stderr)
+            return 2
+        for entry in result["regressions"]:
+            print(
+                f"REGRESSION  {entry['key']}: {entry['old']:g} -> "
+                f"{entry['new']:g} ({entry['change_pct']:+.1f}%, "
+                f"{entry['direction']}-is-better)"
+            )
+        for entry in result["improvements"]:
+            print(
+                f"improved    {entry['key']}: {entry['old']:g} -> "
+                f"{entry['new']:g} ({entry['change_pct']:+.1f}%)"
+            )
+        print(
+            f"{result['compared']} headline keys compared, "
+            f"{len(result['regressions'])} regression(s) beyond "
+            f"{args.threshold:g}%"
+        )
+    if result["compared"] == 0:
+        return 2
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

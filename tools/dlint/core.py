@@ -184,7 +184,14 @@ def run_checks(paths, repo_root: str | None = None,
                checkers=None) -> list[Finding]:
     """Run every checker (or ``checkers``, a list of names) over the
     sources under ``paths``; returns deduplicated, sorted findings."""
-    from tools.dlint import chaos_cov, drift, jit_purity, locks, sigsafe
+    from tools.dlint import (
+        chaos_cov,
+        drift,
+        jit_purity,
+        locks,
+        metric_drift,
+        sigsafe,
+    )
 
     repo_root = repo_root or os.getcwd()
     sources = collect_sources(paths, repo_root)
@@ -195,6 +202,7 @@ def run_checks(paths, repo_root: str | None = None,
         "signal-safety": sigsafe.check_signal_safety,
         "jit-purity": jit_purity.check_jit_purity,
         "message-drift": drift.check_message_drift,
+        "metric-drift": metric_drift.check_metric_drift,
     }
     findings = _allow_findings(sources)
     for name, fn in registry.items():

@@ -16,6 +16,11 @@ Usage:
     # embed the XPlane per-category breakdown when a trace exists
     python tools/obs_report.py --dir ... --trace-dir out/profile --steps 3
 
+    # live view: poll a running master every 2 s — compact goodput /
+    # step-time / MFU tiles with text sparklines from the master's
+    # tiered metrics store, breaches and recent events underneath
+    python tools/obs_report.py --master 127.0.0.1:12345 --live
+
     # machine-readable (the bench embeds this)
     python tools/obs_report.py --dir ... --json
 """
@@ -26,6 +31,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -160,6 +166,141 @@ def _restore_summary(metrics: dict) -> dict:
     return out
 
 
+def warn_events_dropped(report: dict, out=None) -> bool:
+    """LOUD warning when any source's bounded timeline ring overwrote
+    its tail: the merged timeline (and everything derived from it —
+    the ledger's event intervals, the trace forest) is silently
+    missing that source's oldest events, and a truncated report must
+    never read as a complete one. Returns True when it fired."""
+    dropped = report.get("events_dropped") or {}
+    if not dropped:
+        return False
+    out = sys.stderr if out is None else out
+    print("!" * 66, file=out)
+    print(
+        "!! WARNING: timeline events were DROPPED (bounded ring "
+        "overflow);\n!! the merged timeline and ledger intervals are "
+        "INCOMPLETE for:", file=out,
+    )
+    for source, n in sorted(dropped.items()):
+        print(f"!!   {source}: {n} event(s) lost", file=out)
+    print("!" * 66, file=out)
+    return True
+
+
+# ---------------------------------------------------------------- live mode
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 48) -> str:
+    """Text sparkline of the newest ``width`` values."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_CHARS[0] * len(vals)
+    top = len(_SPARK_CHARS) - 1
+    return "".join(
+        _SPARK_CHARS[min(int((v - lo) / (hi - lo) * top + 0.5), top)]
+        for v in vals
+    )
+
+
+_LIVE_EVENT_KINDS = (
+    "elastic.reshape", "master.restart", "master.lost", "ckpt.restore",
+    "rdzv.join", "rdzv.complete", "slo.breach", "slo.clear",
+    "diagnosis.straggler", "diagnosis.hang", "diagnosis.clear",
+    "chaos.fire",
+)
+
+
+def render_live(report: dict, series: dict, slo: dict,
+                events_tail: int = 8) -> str:
+    """One compact live frame: goodput mix, per-source step-time and
+    MFU sparklines (from the master's tiered store), standing SLO
+    breaches and the notable-event tail."""
+    lines = [time.strftime("== dlrover_tpu live == %H:%M:%S")]
+    ledger = report.get("ledger", {})
+    total = ledger.get("total_s", 0.0)
+    cats = ledger.get("categories", {})
+    mix = "  ".join(
+        f"{cat}={secs / total * 100:.1f}%"
+        for cat, secs in cats.items() if total > 0 and secs > 0
+    )
+    lines.append(
+        f"goodput {ledger.get('goodput', 0.0) * 100:5.1f}%  "
+        f"wall {total:8.1f}s  {mix}"
+    )
+    for name, label, fmt in (
+        ("train.step.last_s", "step", lambda v: f"{v * 1e3:8.1f}ms"),
+        ("train.mfu", "mfu ", lambda v: f"{v * 100:8.2f}% "),
+    ):
+        for s in series.get(name, ()):
+            vals = [p[-1] for p in s["points"]]
+            if not vals:
+                continue
+            lines.append(
+                f"{label} {s['source']:<24} {fmt(vals[-1])} "
+                f"{sparkline(vals)}"
+            )
+    if slo:
+        lines.append("SLO BREACHES:")
+        for key, info in sorted(slo.items()):
+            detail = " ".join(
+                f"{k}={v}" for k, v in info.items() if k != "rule"
+            )
+            lines.append(f"  !! {key}: {detail}")
+    else:
+        lines.append("SLO: ok")
+    notable = [
+        ev for ev in report.get("timeline", ())
+        if ev.get("kind") in _LIVE_EVENT_KINDS
+    ][-events_tail:]
+    for ev in notable:
+        lines.append(
+            f"  {time.strftime('%H:%M:%S', time.localtime(ev['t']))} "
+            f"{ev.get('source', '?'):<24} {ev['kind']}"
+        )
+    return "\n".join(lines)
+
+
+def live_loop(master_addr: str, interval: float = 2.0,
+              iterations: int | None = None, out=None) -> int:
+    """Poll the live master and redraw; Ctrl-C exits. ``iterations``
+    bounds the loop for tests."""
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    out = sys.stdout if out is None else out
+    client = MasterClient(master_addr, 0, "tool")
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            n += 1
+            report = client.get_telemetry_report()
+            series = {
+                name: client.query_metrics(name, resolution="raw")
+                for name in ("train.step.last_s", "train.mfu")
+            }
+            slo = dict(client.get_diagnosis().slo or {})
+            frame = render_live(report, series, slo)
+            # ANSI clear between frames so the view reads as a
+            # dashboard, not a scroll; harmless on dumb terminals
+            if n > 1 and out is sys.stdout:
+                print("\033[H\033[2J", end="", file=out)
+            print(frame, file=out, flush=True)
+            warn_events_dropped(report)
+            if iterations is None or n < iterations:
+                time.sleep(interval)
+    except (KeyboardInterrupt, BrokenPipeError):
+        # Ctrl-C, or stdout piped into a pager/head that closed first
+        pass
+    finally:
+        client.close()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -186,9 +327,22 @@ def main(argv=None) -> int:
         help="how many trailing timeline events to print",
     )
     parser.add_argument("--json", action="store_true")
+    parser.add_argument(
+        "--live", action="store_true",
+        help="poll a running master (--master) and redraw a compact "
+        "live view with text sparklines from its metrics store",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="--live polling interval in seconds",
+    )
     args = parser.parse_args(argv)
     if not args.telemetry_dir and not args.master_addr:
         parser.error("need --dir and/or --master")
+    if args.live:
+        if not args.master_addr:
+            parser.error("--live needs --master (a running job)")
+        return live_loop(args.master_addr, interval=args.interval)
 
     report = build_report(
         telemetry_dir=args.telemetry_dir,
@@ -199,6 +353,7 @@ def main(argv=None) -> int:
     if not report.get("sources"):
         print("no telemetry snapshots found", file=sys.stderr)
         return 1
+    warn_events_dropped(report)
     if args.json:
         print(json.dumps(report, indent=2))
     elif args.trace:
