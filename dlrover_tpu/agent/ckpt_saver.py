@@ -728,6 +728,16 @@ class AsyncCheckpointSaver:
         ]
         self._stopped = threading.Event()
         self._threads: list[threading.Thread] = []
+        # high-water mark shared by every per-rank saver thread and the
+        # SIGTERM flush path: locked max-update, or a lagging rank's
+        # commit could roll it backwards past a newer step (dlint
+        # DL008). RLock, not Lock: save_shm_to_storage also runs on the
+        # MAIN thread (breakpoint flush, SIGTERM handler), so a signal
+        # arriving while that same thread holds the lock re-enters the
+        # commit path on the interrupted thread — a non-reentrant lock
+        # would self-deadlock the dying process exactly like the PR-6
+        # logging bug
+        self._persist_lock = threading.RLock()
         self._last_persisted_step = -1
 
     # -- lifecycle ---------------------------------------------------------
@@ -1068,7 +1078,10 @@ class AsyncCheckpointSaver:
                 "/"
             ):
                 self._storage.commit(step, True)
-        self._last_persisted_step = step
+        with self._persist_lock:
+            self._last_persisted_step = max(
+                self._last_persisted_step, step
+            )
 
     def _finalize_step_dir(self, step_dir: str):
         """Hook for atomic-rename savers; base saver writes in place."""
@@ -1082,7 +1095,13 @@ class AsyncCheckpointSaver:
             if result is None:
                 continue
             meta, _ = result
-            if meta.step <= self._last_persisted_step:
+            # locked read: this runs on the main/SIGTERM thread while
+            # saver threads still commit; the lock is reentrant, so a
+            # handler interrupting this very thread mid-hold re-enters
+            # instead of self-deadlocking
+            with self._persist_lock:
+                last_persisted = self._last_persisted_step
+            if meta.step <= last_persisted:
                 continue
             event = SaveEvent(step=meta.step, storage_type="disk")
             try:

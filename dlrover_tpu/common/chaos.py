@@ -266,9 +266,26 @@ class ChaosRegistry:
 
 _REGISTRY: ChaosRegistry | None = None
 
+# dtsan's schedule explorer treats every chaos site as a preemption
+# point: the fault sites already mark exactly the control-plane seams
+# (RPC frames, WAL appends, shm saves, rendezvous joins) where an
+# interleaving can change the outcome. Same no-op contract as the
+# registry: a module-global load plus an ``is None`` branch.
+_YIELD_HOOK = None
+
+
+def set_yield_hook(hook):
+    """Install (or clear, with None) the schedule-explorer callback
+    invoked as ``hook(site, ctx)`` at every chaos site."""
+    global _YIELD_HOOK
+    _YIELD_HOOK = hook
+
 
 def chaos_point(site: str, **ctx):
     """Control-flow fault site. No-op unless a schedule is installed."""
+    hook = _YIELD_HOOK
+    if hook is not None:
+        hook(site, ctx)
     reg = _REGISTRY
     if reg is None:
         return
@@ -278,6 +295,9 @@ def chaos_point(site: str, **ctx):
 def chaos_transform(site: str, data, **ctx):
     """Byte-mutating fault site (checkpoint payloads, manifests).
     Returns ``data`` unchanged (same object, no copy) when disarmed."""
+    hook = _YIELD_HOOK
+    if hook is not None:
+        hook(site, ctx)
     reg = _REGISTRY
     if reg is None:
         return data

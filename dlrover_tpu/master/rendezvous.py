@@ -89,10 +89,14 @@ class RendezvousManager:
             )
 
     def set_coordinator_port(self, port: int):
-        self._coordinator_port = port
+        # locked like every other mutation: dtsan flags the unlocked
+        # write racing export_state/get_comm_world reads
+        with self._lock:
+            self._coordinator_port = port
 
     def get_min_nodes(self) -> int:
-        return self._params.min_nodes
+        with self._lock:
+            return self._params.min_nodes
 
     def add_alive_node(self, node_rank: int):
         pass
@@ -291,7 +295,12 @@ class RendezvousManager:
         raise NotImplementedError
 
     def rdzv_round(self) -> int:
-        return self._rdzv_round
+        # dtsan first-run finding: this read raced _form_round's
+        # increment; an agent polling it could observe a half-formed
+        # round's number and pair round-N verdicts with a round-N+1
+        # world (the mismatch round_verdicts() guards against)
+        with self._lock:
+            return self._rdzv_round
 
     def consensus_restore_step(self) -> int:
         """The NEWEST checkpoint step restorable on every member of the

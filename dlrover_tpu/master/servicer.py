@@ -179,6 +179,10 @@ class MasterServicer(RpcService):
         self._marked_rounds: dict[str, int] = {}
         self._start_training_time = 0.0
         self._job_ended = threading.Event()
+        # servicer-local scalar state written by concurrent RPC handler
+        # threads (dlint DL008 / dtsan first-run findings): one leaf
+        # lock, never held across a call into another component
+        self._state_lock = threading.Lock()
         self._job_success = True
         self._run_configs: dict = {}
 
@@ -315,7 +319,7 @@ class MasterServicer(RpcService):
                 self.metrics_store.ingest_snapshot(local_snap)
             return msg.TelemetryReport(payload=self.telemetry.report())
         if isinstance(message, msg.ElasticRunConfigRequest):
-            return msg.ElasticRunConfig(configs=dict(self._run_configs))
+            return msg.ElasticRunConfig(configs=self.get_run_configs())
         if isinstance(message, msg.SyncBarrierRequest):
             if message.notify:
                 self.sync_service.notify_barrier(message.sync_name)
@@ -449,8 +453,11 @@ class MasterServicer(RpcService):
             )
             return True
         if isinstance(message, msg.GlobalStep):
-            if self._start_training_time == 0:
-                self._start_training_time = time.time()
+            with self._state_lock:
+                # locked check-then-act: two first-step reports racing
+                # here must not both rewrite the start time
+                if self._start_training_time == 0:
+                    self._start_training_time = time.time()
             # node identity threaded through so per-node progress is
             # trackable (hang diagnosis second source) — the message
             # itself predates diagnosis and stays unchanged
@@ -514,7 +521,8 @@ class MasterServicer(RpcService):
                 node.update_service_address(message.addr)
             return True
         if isinstance(message, msg.JobEnd):
-            self._job_success = message.success
+            with self._state_lock:
+                self._job_success = message.success
             self._job_ended.set()
             return True
         if isinstance(message, msg.TelemetrySnapshot):
@@ -611,15 +619,21 @@ class MasterServicer(RpcService):
         rdzv_round, group, world, coordinator = mgr.get_comm_world(
             request.node_id
         )
-        if world and self._marked_rounds.get(request.rdzv_name) != rdzv_round:
+        with self._state_lock:
             # this poll may just have FORMED the round — the membership
             # and consensus step must survive a master failover. Only
             # the round TRANSITION dirties the snapshot: agents poll
             # the formed world every monitor tick (reshape-first
             # membership detection), and re-marking on every poll
             # would make the snapshot writer persist unchanged state
-            # forever.
-            self._marked_rounds[request.rdzv_name] = rdzv_round
+            # forever. Locked: concurrent polls of a fresh round must
+            # produce exactly one transition.
+            newly_marked = world and self._marked_rounds.get(
+                request.rdzv_name
+            ) != rdzv_round
+            if newly_marked:
+                self._marked_rounds[request.rdzv_name] = rdzv_round
+        if newly_marked:
             self._mark_dirty()
         # pass rdzv_round so a round dissolved+re-formed between the
         # two manager calls cannot attach the new round's verdicts to
@@ -652,10 +666,19 @@ class MasterServicer(RpcService):
 
     @property
     def job_success(self) -> bool:
-        return self._job_success
+        with self._state_lock:
+            return self._job_success
 
     def set_run_configs(self, configs: dict):
-        self._run_configs = dict(configs)
+        with self._state_lock:
+            self._run_configs = dict(configs)
+
+    def get_run_configs(self) -> dict:
+        """Snapshot copy for readers (the run-config RPC arm and the
+        state-store collector) — the write side replaces the whole dict
+        under the state lock, so a copy here can never tear."""
+        with self._state_lock:
+            return dict(self._run_configs)
 
 
 def create_master_service(port: int, **managers) -> tuple[RpcServer, MasterServicer]:

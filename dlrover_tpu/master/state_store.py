@@ -221,7 +221,7 @@ class MasterStateStore:
             state["ckpt_barrier"] = (
                 self._servicer.ckpt_barrier.export_state()
             )
-            state["run_configs"] = dict(self._servicer._run_configs)
+            state["run_configs"] = self._servicer.get_run_configs()
             state["telemetry"] = self._servicer.telemetry.snapshots()
             # the live metrics plane's history (tiered series + dedup
             # high-water marks): a restarted master resumes with its

@@ -50,9 +50,14 @@ class _BatchQueueService(RpcService):
     """``get`` pops one preprocessed batch (blocking with timeout)."""
 
     def __init__(self, batch_queue: "queue.Queue", stats: dict,
-                 drained: threading.Event):
+                 drained: threading.Event,
+                 stats_lock: "threading.Lock | None" = None):
         self._queue = batch_queue
         self._stats = stats
+        # shared with the owning CoworkerDataService: the feeder thread
+        # and N RPC handler threads all bump counters in one dict
+        # (dlint DL008)
+        self._stats_lock = stats_lock or threading.Lock()
         self._drained = drained
 
     def get(self, node_type, node_id, message):
@@ -73,7 +78,8 @@ class _BatchQueueService(RpcService):
             if self._drained.is_set():
                 return dict(EOF_BATCH)
             return None
-        self._stats["served"] = self._stats.get("served", 0) + 1
+        with self._stats_lock:
+            self._stats["served"] = self._stats.get("served", 0) + 1
         return batch
 
     def report(self, node_type, node_id, message) -> bool:
@@ -101,10 +107,12 @@ class CoworkerDataService:
         self._iterator_fn = iterator_fn
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self.stats: dict = {"produced": 0, "served": 0}
+        self._stats_lock = threading.Lock()
         self._drained = threading.Event()
         self._server = RpcServer(
             port, _BatchQueueService(self._queue, self.stats,
-                                     self._drained)
+                                     self._drained,
+                                     stats_lock=self._stats_lock)
         )
         self._announce_to = announce_to
         self._announce_every = max(1, int(announce_every))
@@ -143,11 +151,13 @@ class CoworkerDataService:
                         break
                     except queue.Full:
                         continue
-                self.stats["produced"] += 1
+                with self._stats_lock:
+                    self.stats["produced"] += 1
+                    produced = self.stats["produced"]
                 produced_since += 1
                 if announcer is not None and (
                     produced_since >= self._announce_every
-                    or self.stats["produced"] == 1
+                    or produced == 1
                 ):
                     try:
                         announcer.report(

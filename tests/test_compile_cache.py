@@ -43,6 +43,19 @@ _COMPILE_SCRIPT = r"""
 import time
 import jax
 import jax.numpy as jnp
+from jax._src import monitoring
+
+# counter-based proof of cache behavior: the persistent compilation
+# cache records these monitoring events on every lookup
+_events = {"hits": 0, "misses": 0}
+
+def _on_event(name, **kw):
+    if name == "/jax/compilation_cache/cache_hits":
+        _events["hits"] += 1
+    elif name == "/jax/compilation_cache/cache_misses":
+        _events["misses"] += 1
+
+monitoring.register_event_listener(_on_event)
 
 def layer(h, w):
     a = jnp.tanh(h @ w) + h * jax.nn.sigmoid(h @ w.T).mean()
@@ -63,13 +76,19 @@ x = jnp.ones((256, 256))
 t0 = time.perf_counter()
 compiled = jax.jit(step).lower(params, x).compile()
 print(f"COMPILE_S={time.perf_counter() - t0:.4f}")
+print(f"CACHE_HITS={_events['hits']}")
+print(f"CACHE_MISSES={_events['misses']}")
 """
 
 
 class TestRestartRecompileFromCache:
-    def test_second_compile_much_faster(self, tmp_path):
+    def test_second_compile_hits_cache(self, tmp_path):
         """Two fresh processes (a simulated worker restart): the second
-        must compile >=10x faster by replaying the persistent cache."""
+        must replay the persistent cache.  Asserted on jax's own
+        cache-hit/miss monitoring counters plus the cache dir contents
+        — a wall-clock ratio here was one of the seed suite's flaky
+        assertions (neighbor load on a shared VM dilates the cold/warm
+        times independently), so time is only printed, never gated."""
         cache = str(tmp_path / "cc")
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -83,22 +102,34 @@ class TestRestartRecompileFromCache:
                 env=env, capture_output=True, text=True, timeout=300,
             )
             assert out.returncode == 0, out.stderr[-2000:]
+            stats = {}
             for line in out.stdout.splitlines():
-                if line.startswith("COMPILE_S="):
-                    return float(line.split("=")[1])
-            raise AssertionError(f"no timing in output: {out.stdout}")
+                key, _, value = line.partition("=")
+                # only the script's own keys: incidental runtime
+                # output containing '=' must not crash the parse
+                if key in ("COMPILE_S", "CACHE_HITS", "CACHE_MISSES"):
+                    stats[key] = float(value)
+            assert "COMPILE_S" in stats, f"no timing: {out.stdout}"
+            return stats
 
         cold = run_once()
         entries = set(os.listdir(cache))
         assert entries, "cache dir empty after first compile"
+        assert cold["CACHE_HITS"] == 0
+        assert cold["CACHE_MISSES"] >= 1, (
+            "cold run never consulted the persistent cache — the env "
+            "wiring is broken"
+        )
         warm = run_once()
-        # the warm path still pays cache *deserialization* (scales with
-        # program size), so the wall-clock ratio saturates below the
-        # raw compile ratio; require 5x plus proof of an actual hit:
-        # the second run must not write any new cache entries
-        assert warm < cold / 5, (
-            f"expected >=5x faster from cache, got cold={cold:.3f}s "
-            f"warm={warm:.3f}s"
+        print(
+            f"compile: cold={cold['COMPILE_S']:.3f}s "
+            f"warm={warm['COMPILE_S']:.3f}s (informational)"
+        )
+        assert warm["CACHE_HITS"] >= 1, (
+            "second process never hit the persistent cache"
+        )
+        assert warm["CACHE_MISSES"] == 0, (
+            "second process missed the cache and recompiled"
         )
         assert set(os.listdir(cache)) == entries, (
             "second run recompiled (new cache entries) instead of "

@@ -943,6 +943,260 @@ class TestCli:
         assert proc.returncode == 0, proc.stdout
 
 
+# ---------------------------------------------------------------- DL008
+
+
+class TestSharedMutation:
+    def test_two_thread_roots_unguarded_flagged(self, tmp_path):
+        found = lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    threading.Thread(target=self._ticker).start()
+                    threading.Thread(target=self._drainer).start()
+
+                def _ticker(self):
+                    self.count = self.count + 1
+
+                def _drainer(self):
+                    self.count = 0
+        """, "shared-mut")
+        assert len(found) == 1
+        assert found[0].code == "DL008"
+        assert "C.count" in found[0].message
+        assert "no common lock" in found[0].message
+
+    def test_common_lock_is_clean(self, tmp_path):
+        assert lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    threading.Thread(target=self._ticker).start()
+                    threading.Thread(target=self._drainer).start()
+
+                def _ticker(self):
+                    with self._lock:
+                        self.count = self.count + 1
+
+                def _drainer(self):
+                    with self._lock:
+                        self.count = 0
+        """, "shared-mut") == []
+
+    def test_lock_flows_into_callee(self, tmp_path):
+        """A write in a helper called under the lock is guarded —
+        the held context follows the call graph."""
+        assert lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    threading.Thread(target=self._ticker).start()
+                    threading.Thread(target=self._drainer).start()
+
+                def _ticker(self):
+                    with self._lock:
+                        self._bump()
+
+                def _bump(self):
+                    self.count = self.count + 1
+
+                def _drainer(self):
+                    with self._lock:
+                        self.count = 0
+        """, "shared-mut") == []
+
+    def test_condition_aliases_to_wrapped_lock(self, tmp_path):
+        """The kvstore idiom: Condition(self._lock) and the lock
+        itself guard the same critical sections."""
+        assert lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+
+                def start(self):
+                    threading.Thread(target=self._put).start()
+                    threading.Thread(target=self._take).start()
+
+                def _put(self):
+                    with self._cond:
+                        self.pending = self.pending + 1
+
+                def _take(self):
+                    with self._lock:
+                        self.pending = 0
+        """, "shared-mut") == []
+
+    def test_disjoint_locks_flagged(self, tmp_path):
+        found = lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    threading.Thread(target=self._put).start()
+                    threading.Thread(target=self._take).start()
+
+                def _put(self):
+                    with self._a_lock:
+                        self.pending = self.pending + 1
+
+                def _take(self):
+                    with self._b_lock:
+                        self.pending = 0
+        """, "shared-mut")
+        assert len(found) == 1
+        assert "C.pending" in found[0].message
+
+    def test_loop_spawn_counts_as_two_roots(self, tmp_path):
+        """N sibling threads of ONE target race each other — the
+        ckpt-saver per-rank shape."""
+        found = lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    for i in range(4):
+                        threading.Thread(
+                            target=self._persist, args=(i,)
+                        ).start()
+
+                def _persist(self, i):
+                    self.last_step = i
+        """, "shared-mut")
+        assert len(found) == 1
+        assert "C.last_step" in found[0].message
+
+    def test_single_root_single_thread_clean(self, tmp_path):
+        assert lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.beat = self.beat + 1
+        """, "shared-mut") == []
+
+    def test_two_spawn_sites_of_one_target_flagged(self, tmp_path):
+        """Spawn sites are roots, not targets: two spawns of ONE
+        target are two concurrent siblings sharing self."""
+        found = lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    threading.Thread(target=self._work).start()
+
+                def boost(self):
+                    threading.Thread(target=self._work).start()
+
+                def _work(self):
+                    self.count = self.count + 1
+        """, "shared-mut")
+        assert len(found) == 1
+        assert "C.count" in found[0].message
+
+    def test_thread_subclass_run_races_other_root(self, tmp_path):
+        """run() of a Thread subclass is a root: its write races the
+        timer tick's write to the same instance field."""
+        found = lint_file(tmp_path, """
+            import threading
+
+            class Worker(threading.Thread):
+                def arm(self):
+                    threading.Timer(1.0, self._tick).start()
+
+                def run(self):
+                    self.count = self.count + 1
+
+                def _tick(self):
+                    self.count = 0
+        """, "shared-mut")
+        assert len(found) == 1
+        assert "Worker.count" in found[0].message
+
+    def test_servicer_arms_are_roots(self, tmp_path):
+        """get/report run thread-per-connection: a bare field write
+        from either is concurrent with itself."""
+        found = lint_file(tmp_path, """
+            class FooServicer(RpcService):
+                def get(self, node_type, node_id, message):
+                    self.calls = self.calls + 1
+                    return None
+
+                def report(self, node_type, node_id, message):
+                    return True
+        """, "shared-mut")
+        assert len(found) == 1
+        assert "FooServicer.calls" in found[0].message
+
+    def test_mutator_on_component_not_flagged(self, tmp_path):
+        """self.store.update(...) on a non-container component is that
+        component's locking discipline, not a bare-container write."""
+        assert lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self, store):
+                    self.store = store
+
+                def start(self):
+                    threading.Thread(target=self._a).start()
+                    threading.Thread(target=self._b).start()
+
+                def _a(self):
+                    self.store.update({"x": 1})
+
+                def _b(self):
+                    self.store.update({"y": 2})
+        """, "shared-mut") == []
+
+    def test_mutator_on_plain_container_flagged(self, tmp_path):
+        found = lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.items = []
+
+                def start(self):
+                    threading.Thread(target=self._a).start()
+                    threading.Thread(target=self._b).start()
+
+                def _a(self):
+                    self.items.append(1)
+
+                def _b(self):
+                    self.items.clear()
+        """, "shared-mut")
+        assert len(found) == 1
+        assert "C.items" in found[0].message
+
+    def test_allow_dl008_suppresses(self, tmp_path):
+        found = lint_file(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    threading.Thread(target=self._a).start()
+                    threading.Thread(target=self._b).start()
+
+                def _a(self):
+                    # dlint: allow-DL008(single-writer by protocol: _b only runs after _a joins)
+                    self.x = 1
+
+                def _b(self):
+                    self.x = 2
+        """, "shared-mut")
+        assert found == []
+
+
 # ------------------------------------------------------- the tier-1 gate
 
 

@@ -1,0 +1,104 @@
+"""Tier-1 race coverage over the REAL control-plane subsystems.
+
+Every named scenario in ``tools/dtsan/scenarios.py`` runs here in both
+modes:
+
+- **detector**: real threads + vector clocks — the gate is ZERO race
+  reports (no baselining: a report here is a bug to fix in the
+  subsystem, or a deliberate lock-free idiom to exclude from
+  registration with a reason);
+- **explorer**: a bounded seeded sweep of deterministic interleavings —
+  the gate is zero failing schedules (races, invariant violations,
+  deadlocks).
+
+The fast sweeps here are sized for tier-1 (a few schedules each); the
+``slow``-marked sweep at the bottom runs the full CHESS-style walk.
+A failure prints the seed — replay it exactly with::
+
+    python tools/race_run.py <scenario> --mode replay --seed <seed>
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from tools import dtsan
+from tools.dtsan.scenarios import SCENARIOS
+
+pytestmark = pytest.mark.race
+
+_NAMES = sorted(SCENARIOS)
+
+
+@pytest.fixture
+def dt():
+    det = dtsan.enable()
+    try:
+        yield det
+    finally:
+        dtsan.disable()
+
+
+@pytest.mark.parametrize("name", _NAMES)
+def test_detector_clean(name, dt):
+    """Real threads through the real subsystem: no unsynchronized
+    access to any registered shared field, and the scenario's own
+    invariant holds."""
+    races, err = SCENARIOS[name].run_detect()
+    assert err is None, f"{name}: invariant check failed: {err!r}"
+    assert races == [], (
+        f"{name}: dtsan race reports (fix the subsystem, do not "
+        "baseline):\n" + "\n".join(r.format() for r in races)
+    )
+
+
+@pytest.mark.parametrize("name", _NAMES)
+def test_explorer_fast_sweep_clean(name, dt):
+    """A short seeded walk over forced interleavings stays clean."""
+    res = dtsan.explore(
+        SCENARIOS[name].make, schedules=4, seed=29,
+        preemption_bound=2, stop_on_failure=True, timeout=30,
+    )
+    assert not res.failed, f"{name}:\n{res.describe()}"
+
+
+def test_replay_of_real_scenario_is_bit_identical(dt):
+    """The chaos-schedule contract, applied to interleavings: one seed,
+    one schedule — byte-equal traces and decisions across runs."""
+    make = SCENARIOS["kvstore-evict"].make
+    r1 = dtsan.replay(make, seed=12345, preemption_bound=2)
+    r2 = dtsan.replay(make, seed=12345, preemption_bound=2)
+    assert r1.trace == r2.trace
+    assert r1.decisions == r2.decisions
+    assert r1.preemption_points == r2.preemption_points
+    assert [r.key for r in r1.races] == [r.key for r in r2.races]
+
+
+def test_race_run_cli_lists_scenarios():
+    proc = subprocess.run(
+        [sys.executable, "tools/race_run.py", "--list"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for name in _NAMES:
+        assert name in proc.stdout
+
+
+def test_unknown_scenario_is_usage_error():
+    proc = subprocess.run(
+        [sys.executable, "tools/race_run.py", "no-such-scenario"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", _NAMES)
+def test_explorer_full_sweep_clean(name, dt):
+    """The full walk: more schedules, deeper preemption bound."""
+    res = dtsan.explore(
+        SCENARIOS[name].make, schedules=40, seed=101,
+        preemption_bound=3, stop_on_failure=True, timeout=60,
+    )
+    assert not res.failed, f"{name}:\n{res.describe()}"

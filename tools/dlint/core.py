@@ -37,7 +37,9 @@ _ALLOW_RE = re.compile(
     r"#\s*dlint:\s*(?P<body>[^#]*)"
 )
 _DIRECTIVE_RE = re.compile(
-    r"allow(?:-(?P<name>[a-z0-9-]+))?(?:\((?P<reason>[^)]*)\))?"
+    # the checker name is case-insensitive so code ids read naturally:
+    # "allow-DL008(...)" and "allow-shared-mut(...)" both work
+    r"allow(?:-(?P<name>[A-Za-z0-9-]+))?(?:\((?P<reason>[^)]*)\))?"
 )
 
 ALLOW_ALL = "all"
@@ -110,7 +112,7 @@ class SourceFile:
                 for d in _DIRECTIVE_RE.finditer(m.group("body")):
                     if not d.group(0).startswith("allow"):
                         continue
-                    name = d.group("name") or ALLOW_ALL
+                    name = (d.group("name") or ALLOW_ALL).lower()
                     reason = (d.group("reason") or "").strip()
                     if not reason:
                         self.bad_allows.append(lineno)
@@ -190,6 +192,7 @@ def run_checks(paths, repo_root: str | None = None,
         jit_purity,
         locks,
         metric_drift,
+        shared_mut,
         sigsafe,
     )
 
@@ -203,7 +206,17 @@ def run_checks(paths, repo_root: str | None = None,
         "jit-purity": jit_purity.check_jit_purity,
         "message-drift": drift.check_message_drift,
         "metric-drift": metric_drift.check_metric_drift,
+        "shared-mut": shared_mut.check_shared_mutation,
     }
+    if checkers is not None:
+        unknown = set(checkers) - set(registry)
+        if unknown:
+            # a silently-ignored checker name runs NOTHING and exits
+            # green — the one failure mode a gate must not have
+            raise ValueError(
+                f"unknown checker(s) {sorted(unknown)}; "
+                f"have: {sorted(registry)}"
+            )
     findings = _allow_findings(sources)
     for name, fn in registry.items():
         if checkers is not None and name not in checkers:

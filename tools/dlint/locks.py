@@ -353,10 +353,12 @@ def _call_edges(src, index, ml: _ModuleLocks, edges, witnesses):
                             )
 
 
-def check_lock_order(sources) -> list[Finding]:
-    analyzed = _analyze(sources)
-    # global edge graph: (a, b) -> (file, line); lock keys are
-    # Class.attr so the graph merges across modules
+def _global_edges(analyzed):
+    """The repo-wide acquisition-order graph: (a, b) -> (file, line)
+    plus a witness label per edge.  Lock keys are Class.attr so the
+    graph merges across modules.  Shared by :func:`check_lock_order`
+    and :func:`lock_inventory` — the catalog must never drift from the
+    findings it claims to be generated from."""
     edges: dict[tuple[str, str], tuple[str, int]] = {}
     witnesses: dict[tuple[str, str], str] = {}
     for src, index, ml in analyzed:
@@ -364,6 +366,12 @@ def check_lock_order(sources) -> list[Finding]:
             edges.setdefault((a, b), (src.relpath, ln))
             witnesses.setdefault((a, b), "lexical nesting")
         _call_edges(src, index, ml, edges, witnesses)
+    return edges, witnesses
+
+
+def check_lock_order(sources) -> list[Finding]:
+    analyzed = _analyze(sources)
+    edges, witnesses = _global_edges(analyzed)
 
     findings = []
     seen_pairs = set()
@@ -399,6 +407,41 @@ def check_lock_order(sources) -> list[Finding]:
             detail=f"order|{min(a, b)}|{max(a, b)}",
         ))
     return findings
+
+
+def lock_inventory(sources) -> dict:
+    """The repo's lock catalog, derived from the DL001 model: every
+    lock key (``Class.attr`` / ``module.name``), its reentrancy, its
+    acquisition sites, and the observed ordering edges.  Feeds
+    ``tools/lint.py --lock-inventory`` and the DESIGN.md "Concurrency
+    model" section's generated catalog."""
+    locks: dict[str, dict] = {}
+    analyzed = _analyze(sources)
+    for src, _index, ml in analyzed:
+        for _qual, acquired in sorted(ml.acquired.items()):
+            for key, ln in acquired:
+                entry = locks.setdefault(
+                    key, {"reentrant": False, "sites": set()}
+                )
+                entry["sites"].add(f"{src.relpath}:{ln}")
+        for key in ml.reentrant:
+            locks.setdefault(
+                key, {"reentrant": False, "sites": set()}
+            )["reentrant"] = True
+    edges, _witnesses = _global_edges(analyzed)
+    return {
+        "locks": {
+            key: {
+                "reentrant": entry["reentrant"],
+                "sites": sorted(entry["sites"]),
+            }
+            for key, entry in sorted(locks.items())
+        },
+        "edges": [
+            {"outer": a, "inner": b, "witness": f"{file}:{line}"}
+            for (a, b), (file, line) in sorted(edges.items())
+        ],
+    }
 
 
 def _blocking_label(call: ast.Call) -> str | None:

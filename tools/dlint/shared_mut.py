@@ -1,0 +1,468 @@
+"""DL008 unsynchronized shared mutation.
+
+Invariant encoded: an instance field written from two concurrency
+*roots* must have one lock every write path holds.  Roots are where
+threads enter the code:
+
+- ``threading.Thread(target=...)`` / ``threading.Timer(..., fn)``
+  entry points (a spawn inside a loop counts as TWO roots — N sibling
+  threads of one target race each other);
+- ``run()`` of a ``threading.Thread`` subclass;
+- servicer dispatch arms (``get``/``report`` of ``RpcService``
+  subclasses — the RPC server runs them thread-per-connection);
+- signal handlers (``signal.signal(sig, fn)``).
+
+From each root the checker walks the same-module call graph carrying
+the *held-lock context* (the DL001 region model: ``with`` blocks and
+acquire/release spans, plus locks held at the call site flowing into
+callees), collects every ``self.X`` write — assignments, augmented
+assignments, and known mutator calls (``self.X.append(...)``) — and
+flags fields whose writes share no common lock.  ``threading.Condition
+(self._lock)`` aliases to its wrapped lock, so a field guarded by the
+lock on one path and the condition on another is correctly clean.
+
+This is dtsan's static sibling: the dynamic detector proves what raced
+in a run; DL008 proves the *discipline* over every path the AST can
+see, including ones no test drives.  Escape hatch:
+``# dlint: allow-DL008(reason)`` (or ``allow-shared-mut``) on the
+write line or its enclosing ``def``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dlint.astutil import call_name, dotted, index_for, last_attr
+from tools.dlint.core import Finding
+from tools.dlint.locks import _analyze
+
+# follow the call graph this many hops from a root
+_CALL_DEPTH = 5
+
+# method names that mutate their receiver (``self.X.append(...)`` is a
+# write to the X field's contents)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault", "sort", "reverse", "rotate",
+})
+
+# fields that ARE sync/thread plumbing: assigning a new Thread/Event
+# handle from two roots is a lifecycle question, not a data race the
+# vector-clock model describes — keep DL008 focused on data fields
+_PLUMBING_SUFFIXES = ("_lock", "_cond", "_thread", "_threads")
+
+
+class _Root:
+    __slots__ = ("qual", "label", "multi")
+
+    def __init__(self, qual: str, label: str, multi: bool):
+        self.qual = qual
+        self.label = label
+        self.multi = multi  # spawned in a loop: N sibling threads
+
+
+class _Write:
+    __slots__ = ("root", "qual", "line", "held")
+
+    def __init__(self, root: _Root, qual: str, line: int,
+                 held: frozenset):
+        self.root = root
+        self.qual = qual
+        self.line = line
+        self.held = held
+
+
+def _target_qual(expr_name: str, index, class_name: str | None):
+    """Resolve a callback reference (``self._loop``, bare ``fn``,
+    ``Cls.m``) to a module function qualname."""
+    if not expr_name:
+        return None
+    head, _, tail = expr_name.rpartition(".")
+    if head in ("self", "cls") and class_name:
+        q = f"{class_name}.{tail}"
+        return q if q in index.functions else None
+    if not head:
+        return expr_name if expr_name in index.functions else None
+    if head in index.classes and f"{head}.{tail}" in index.functions:
+        return f"{head}.{tail}"
+    return None
+
+
+def _thread_roots(src, index) -> list[_Root]:
+    """Thread/Timer targets and signal handlers, with loop-spawn
+    detection (ancestors tracked by a recursive walk)."""
+    roots: list[_Root] = []
+
+    def visit(node, loop_depth: int, class_name: str | None):
+        for child in ast.iter_child_nodes(node):
+            cdepth = loop_depth
+            ccls = class_name
+            if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                cdepth += 1
+            elif isinstance(child, ast.ClassDef):
+                ccls = child.name
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # a nested spawn loop restarts at its own def
+                visit(child, 0, ccls)
+                continue
+            if isinstance(child, ast.Call):
+                name = call_name(child)
+                tail = last_attr(name)
+                cb = None
+                if tail in ("Thread", "Timer"):
+                    for kw in child.keywords:
+                        if kw.arg == "target":
+                            cb = dotted(kw.value)
+                    if tail == "Timer" and cb is None and \
+                            len(child.args) >= 2:
+                        cb = dotted(child.args[1])
+                elif name in ("signal.signal",) and len(child.args) >= 2:
+                    cb = dotted(child.args[1])
+                if cb:
+                    owner = index.enclosing(child.lineno)
+                    owner_cls = None
+                    info = index.functions.get(owner)
+                    if info is not None:
+                        owner_cls = info.class_name
+                    qual = _target_qual(cb, index, owner_cls)
+                    if qual is not None:
+                        # label per SPAWN SITE, not per target: two
+                        # spawns of one target (from different methods
+                        # or repeated) are two concurrent siblings
+                        roots.append(_Root(
+                            qual,
+                            f"thread:{qual}@{child.lineno}",
+                            multi=cdepth > 0,
+                        ))
+            visit(child, cdepth, ccls)
+
+    visit(src.tree, 0, None)
+    return roots
+
+
+def _class_roots(src, index) -> list[_Root]:
+    """Thread-subclass run() methods and servicer dispatch arms."""
+    roots = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = {dotted(b) for b in node.bases}
+        base_tails = {last_attr(b) for b in bases if b}
+        if "Thread" in base_tails and f"{node.name}.run" in \
+                index.functions:
+            # multi=False is deliberate: sibling INSTANCES of a Thread
+            # subclass each own their self.X — run() only races fields
+            # also written from some OTHER root on the same instance
+            roots.append(_Root(
+                f"{node.name}.run", f"thread:{node.name}.run",
+                multi=False,
+            ))
+        # RPC dispatch: the server runs get/report thread-per-connection
+        if "RpcService" in base_tails or node.name.endswith("Servicer"):
+            for verb in ("get", "report"):
+                q = f"{node.name}.{verb}"
+                if q in index.functions:
+                    roots.append(_Root(q, f"rpc:{q}", multi=True))
+    return roots
+
+
+def _cond_aliases(src, index, ml) -> dict[str, str]:
+    """Class.cond -> Class.lock for ``self.c = threading.Condition(
+    self.l)`` assignments (the kvstore idiom): both keys guard the same
+    critical sections."""
+    aliases: dict[str, str] = {}
+    for node in index.all_assigns:
+        if not isinstance(node.value, ast.Call):
+            continue
+        if last_attr(call_name(node.value)) != "Condition":
+            continue
+        if not node.value.args:
+            continue
+        inner = dotted(node.value.args[0])
+        if not inner:
+            continue
+        info = index.functions.get(index.enclosing(node.lineno))
+        cls = info.class_name if info is not None else None
+        for tgt in node.targets:
+            name = dotted(tgt)
+            if name:
+                aliases[ml.lock_key(name, cls)] = ml.lock_key(
+                    inner, cls
+                )
+    return aliases
+
+
+_CONTAINER_CTORS = frozenset({
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter",
+})
+
+
+def _container_fields(src, index) -> dict[str, set[str]]:
+    """class -> fields assigned a PLAIN container anywhere in the class
+    (literal or stdlib ctor).  Method-call mutators (``self.X.add()``)
+    only count as DL008 writes for these fields — on anything else the
+    call is a component with its own locking discipline (the kv store,
+    the telemetry merge), not a bare container."""
+    out: dict[str, set[str]] = {}
+    for node in index.all_assigns:
+        v = node.value
+        is_container = isinstance(
+            v, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+                ast.SetComp)
+        ) or (
+            isinstance(v, ast.Call)
+            and last_attr(call_name(v)) in _CONTAINER_CTORS
+        )
+        if not is_container:
+            continue
+        info = index.functions.get(index.enclosing(node.lineno))
+        cls = info.class_name if info is not None else None
+        if cls is None:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and isinstance(
+                tgt.value, ast.Name
+            ) and tgt.value.id == "self":
+                out.setdefault(cls, set()).add(tgt.attr)
+    return out
+
+
+def _condish(name: str) -> bool:
+    tail = last_attr(name).lower()
+    return "cond" in tail and not tail.endswith(("_condition_met",))
+
+
+def _cond_regions(index, ml) -> dict[str, list[tuple[str, int, int]]]:
+    """``with self._cond:`` held regions.  The DL001 lexical model only
+    tracks *lock*-named objects; a Condition guards its wrapped lock's
+    critical sections just the same, so DL008 adds these regions and
+    the alias map folds them onto the lock key."""
+    out: dict[str, list[tuple[str, int, int]]] = {}
+    for qual, info in index.functions.items():
+        regions = []
+        for node in _function_body_nodes(info.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                name = dotted(item.context_expr)
+                if name and _condish(name):
+                    regions.append((
+                        ml.lock_key(name, info.class_name),
+                        node.lineno,
+                        node.end_lineno or node.lineno,
+                    ))
+        if regions:
+            out[qual] = regions
+    return out
+
+
+def _held_at(ml, facts, qual: str, line: int,
+             incoming: frozenset) -> frozenset:
+    held = set(incoming)
+    for key, _wl, start, end in ml.regions.get(qual, ()):
+        if start <= line <= end:
+            held.add(key)
+    for key, start, end in facts.cond_regions.get(qual, ()):
+        if start <= line <= end:
+            held.add(key)
+    return frozenset(held)
+
+
+def _self_write_field(node, container_fields: set[str]
+                      ) -> tuple[str, int] | None:
+    """(field, line) when ``node`` writes ``self.X`` (or mutates a
+    known plain-container field via ``self.X.<mutator>(...)``)."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        for tgt in targets:
+            # unwrap subscript: self.X[k] = v writes X's contents
+            while isinstance(tgt, ast.Subscript):
+                tgt = tgt.value
+            if isinstance(tgt, ast.Attribute) and isinstance(
+                tgt.value, ast.Name
+            ) and tgt.value.id == "self":
+                return tgt.attr, node.lineno
+    elif isinstance(node, ast.Call):
+        name = call_name(node)
+        parts = name.split(".")
+        if (
+            len(parts) == 3 and parts[0] == "self"
+            and parts[2] in _MUTATORS
+            and parts[1] in container_fields
+        ):
+            return parts[1], node.lineno
+    return None
+
+
+class _ModuleFacts:
+    """Per-module, per-function facts computed ONCE (the DFS below
+    revisits functions in many held-lock contexts — re-walking bodies
+    per context is the difference between the tier-1 gate's <5s budget
+    and blowing it)."""
+
+    def __init__(self, src, index, ml, container_fields):
+        # qual -> [(field, line)] self-writes
+        self.writes: dict[str, list[tuple[str, int]]] = {}
+        # qual -> [(callee_qual, call line)]
+        self.callees: dict[str, list[tuple[str, int]]] = {}
+        # qual -> [(cond key, start, end)] condition-held regions
+        self.cond_regions = _cond_regions(index, ml)
+        for qual, info in index.functions.items():
+            cls_containers = container_fields.get(
+                info.class_name or "", set()
+            )
+            writes = []
+            if info.class_name is not None:
+                # nested defs excluded: they run on their own schedule
+                # and are roots themselves if spawned
+                for node in _function_body_nodes(info.node):
+                    hit = _self_write_field(node, cls_containers)
+                    if hit is not None:
+                        writes.append(hit)
+            self.writes[qual] = writes
+            callees = []
+            for call in index.calls_by_func.get(qual, ()):
+                callee = _target_qual(
+                    call_name(call), index, info.class_name
+                )
+                if callee is not None:
+                    callees.append((callee, call.lineno))
+            self.callees[qual] = callees
+
+
+def _collect_writes(index, ml, root: _Root, facts: _ModuleFacts):
+    """DFS from a root through same-module callees, carrying held
+    locks; yields (class_name, field, _Write)."""
+    out = []
+    seen: set[tuple[str, frozenset]] = set()
+
+    def walk(qual: str, incoming: frozenset, depth: int):
+        state = (qual, incoming)
+        if state in seen or depth > _CALL_DEPTH:
+            return
+        seen.add(state)
+        info = index.functions.get(qual)
+        if info is None:
+            return
+        for field, line in facts.writes.get(qual, ()):
+            held = _held_at(ml, facts, qual, line, incoming)
+            out.append((
+                info.class_name, field,
+                _Write(root, qual, line, held),
+            ))
+        # follow callees with the locks held at each call site
+        for callee, line in facts.callees.get(qual, ()):
+            walk(
+                callee, _held_at(ml, facts, qual, line, incoming),
+                depth + 1,
+            )
+
+    walk(root.qual, frozenset(), 0)
+    return out
+
+
+def _function_body_nodes(fn_node):
+    """ast.walk limited to this function (nested defs skipped)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# bare "Thread" (not "Thread("): `class Worker(threading.Thread):`
+# modules must not be pre-filtered away — their run() is a root
+_ROOT_MARKERS = (
+    "Thread", "Timer(", "signal.signal", "RpcService", "Servicer",
+)
+
+
+def check_shared_mutation(sources) -> list[Finding]:
+    findings = []
+    for src, index, ml in _analyze(sources):
+        # text pre-filter: most modules have no concurrency roots, and
+        # the root scans walk the full tree (tier-1 gate budget)
+        if not any(m in src.text for m in _ROOT_MARKERS):
+            continue
+        roots = _thread_roots(src, index) + _class_roots(src, index)
+        if not roots:
+            continue
+        aliases = _cond_aliases(src, index, ml)
+        facts = _ModuleFacts(
+            src, index, ml, _container_fields(src, index)
+        )
+
+        def canon(held: frozenset) -> frozenset:
+            return frozenset(aliases.get(k, k) for k in held)
+
+        # (class, field) -> [_Write]; dedupe (root.label, line) pairs so
+        # one textual root listed twice cannot fake two roots
+        by_field: dict[tuple[str, str], dict[tuple, _Write]] = {}
+        for root in roots:
+            for cls, field, write in _collect_writes(
+                index, ml, root, facts
+            ):
+                if field.endswith(_PLUMBING_SUFFIXES):
+                    continue
+                by_field.setdefault((cls, field), {})[
+                    (root.label, write.line)
+                ] = write
+
+        for (cls, field), writes_map in sorted(by_field.items()):
+            writes = [
+                w for w in writes_map.values()
+                if not (
+                    src.allowed(
+                        "shared-mut", w.line,
+                        index.functions[w.qual].node.lineno,
+                    )
+                    or src.allowed(
+                        "dl008", w.line,
+                        index.functions[w.qual].node.lineno,
+                    )
+                )
+            ]
+            root_labels = {w.root.label for w in writes}
+            effective_roots = len(root_labels) + sum(
+                1 for lbl in root_labels
+                if next(
+                    w for w in writes if w.root.label == lbl
+                ).root.multi
+            )
+            if effective_roots < 2:
+                continue
+            common = None
+            for w in writes:
+                held = canon(w.held)
+                common = held if common is None else (common & held)
+            if common:
+                continue
+            first = min(writes, key=lambda w: w.line)
+            sites = ", ".join(
+                f"{w.qual}:{w.line}"
+                for w in sorted(writes, key=lambda w: w.line)[:4]
+            )
+            findings.append(Finding(
+                checker="shared-mut", code="DL008",
+                file=src.relpath, line=first.line,
+                message=(
+                    f"{cls}.{field} written from {effective_roots} "
+                    f"concurrent roots "
+                    f"({', '.join(sorted(root_labels)[:3])}) with no "
+                    f"common lock across all writes ({sites}) — "
+                    f"unsynchronized shared mutation"
+                ),
+                detail=f"{cls}.{field}",
+            ))
+    return findings

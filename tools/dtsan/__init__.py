@@ -1,0 +1,83 @@
+"""dtsan — happens-before race detection + deterministic schedule
+exploration for the project's threaded Python control plane.
+
+Two modes, composable:
+
+- **Detector** (``enable()`` + ``shared()``): instrumented
+  ``threading`` primitives maintain per-thread vector clocks; registered
+  shared fields record read/write epochs; any unsynchronized
+  cross-thread access yields a :class:`Race` with both stacks.
+  Real threads, real timing — catches what actually raced.
+- **Explorer** (``explore()``/``replay()``/``minimize()``): a
+  cooperative scheduler serializes the scenario's threads and forces
+  preemptions at instrumented yield points (lock ops, chaos sites,
+  shared-variable accesses), driven by a seeded random walk with
+  preemption bounding — catches what *could* race, and replays any
+  failure bit-identically from its seed.
+
+Strict no-op contract (the chaos/telemetry guard idiom): until
+``enable()`` runs nothing is patched and every hook is a module-global
+load plus an ``is None`` branch; ``disable()`` restores every patched
+construction site and class.
+
+Quickstart::
+
+    from tools import dtsan
+
+    dtsan.enable()
+    try:
+        store = KVStoreService(max_entries=4)   # locks now instrumented
+        dtsan.shared(store)                     # known-singleton table
+        ... run threads ...
+        assert dtsan.races() == []
+    finally:
+        dtsan.disable()
+
+See ``tools/race_run.py`` for the named-scenario CLI and
+docs/DESIGN.md "Concurrency model" for the full contract.
+"""
+
+from tools.dtsan.clocks import Access, Race, VectorClock  # noqa: F401
+from tools.dtsan.known import KNOWN_SHARED, auto_register  # noqa: F401
+from tools.dtsan.runtime import (  # noqa: F401
+    Detector,
+    TrackedCondition,
+    TrackedEvent,
+    TrackedLock,
+    TrackedRLock,
+    TrackedThread,
+    active_detector,
+    disable,
+    enable,
+    shared,
+    wrap_lock,
+)
+from tools.dtsan.sched import (  # noqa: F401
+    DeadlockError,
+    ExploreResult,
+    ScheduleResult,
+    Scheduler,
+    SchedulerError,
+    explore,
+    minimize,
+    replay,
+    run_schedule,
+)
+
+
+def races() -> list:
+    """The enabled detector's deduplicated race reports ([] when
+    disabled)."""
+    det = active_detector()
+    return det.races() if det is not None else []
+
+
+def assert_race_free():
+    """Raise ``AssertionError`` with full two-sided stacks when the
+    detector holds any race report."""
+    found = races()
+    if found:
+        raise AssertionError(
+            f"dtsan found {len(found)} race(s):\n"
+            + "\n".join(r.format() for r in found)
+        )

@@ -1,0 +1,125 @@
+"""Vector clocks, access epochs, and race records for dtsan.
+
+The detector is FastTrack-shaped (Flanagan & Freund, PLDI'09) but keeps
+the bookkeeping deliberately simple — this is a test-time tool for a
+control plane with tens of threads, not a production JIT pass:
+
+- every *tracked* thread carries a vector clock ``C_t`` (dtsan-tid ->
+  epoch counter);
+- every instrumented sync object (lock, condition, event) carries a
+  clock that release/set stores into and acquire/wait joins from;
+- every registered shared variable keeps its last-write epoch and a
+  per-thread read map, each with the stack that produced it, so a race
+  report shows BOTH sides.
+
+Happens-before: an access epoch ``(u, c)`` happened before thread t's
+current point iff ``c <= C_t[u]``.  Two accesses to one variable, at
+least one a write, with neither ordered — that is the race.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class VectorClock(dict):
+    """dtsan-tid -> epoch counter.  Missing components are 0."""
+
+    def advance(self, tid: int):
+        self[tid] = self.get(tid, 0) + 1
+
+    def join(self, other: "VectorClock | dict"):
+        for tid, c in other.items():
+            if c > self.get(tid, 0):
+                self[tid] = c
+
+    def copy(self) -> "VectorClock":
+        return VectorClock(self)
+
+    def covers(self, tid: int, c: int) -> bool:
+        """True when epoch ``(tid, c)`` happened before this clock."""
+        return c <= self.get(tid, 0)
+
+
+class Access:
+    """One recorded access: who, when (epoch), and from where."""
+
+    __slots__ = ("tid", "clock", "thread_name", "stack", "write")
+
+    def __init__(self, tid: int, clock: int, thread_name: str,
+                 stack: list, write: bool):
+        self.tid = tid
+        self.clock = clock
+        self.thread_name = thread_name
+        self.stack = stack
+        self.write = write
+
+    @property
+    def site(self) -> str:
+        """``file:line`` of the outermost user frame (dedup key)."""
+        if not self.stack:
+            return "?"
+        f = self.stack[-1]
+        return f"{f.filename}:{f.lineno}"
+
+    def format(self) -> str:
+        kind = "write" if self.write else "read"
+        head = f"  {kind} by thread {self.thread_name!r} at:\n"
+        return head + "".join(
+            f"    {f.filename}:{f.lineno} in {f.name}\n      {f.line}\n"
+            for f in self.stack
+        )
+
+
+class VarState:
+    """Per registered (object, field) detector state."""
+
+    __slots__ = ("name", "last_write", "reads")
+
+    def __init__(self, name: str):
+        self.name = name          # human key, e.g. "KVStoreService._bytes"
+        self.last_write: Access | None = None
+        self.reads: dict[int, Access] = {}   # tid -> newest read
+
+
+class Race:
+    """One detected race: the variable plus both unordered accesses."""
+
+    def __init__(self, var: str, kind: str, prior: Access,
+                 current: Access):
+        self.var = var
+        self.kind = kind          # "write-write" | "read-write" | "write-read"
+        self.prior = prior
+        self.current = current
+
+    @property
+    def key(self) -> tuple:
+        """Dedup key: one report per (variable, kind, site pair)."""
+        return (
+            self.var, self.kind,
+            frozenset((self.prior.site, self.current.site)),
+        )
+
+    def format(self) -> str:
+        return (
+            f"dtsan: {self.kind} race on {self.var}\n"
+            + self.prior.format()
+            + self.current.format()
+        )
+
+    def __repr__(self):
+        return (
+            f"Race({self.var!r}, {self.kind!r}, "
+            f"{self.prior.site} <-> {self.current.site})"
+        )
+
+
+def capture_stack(skip_prefixes: tuple[str, ...], limit: int = 24) -> list:
+    """The current stack, innermost-last, with dtsan's own frames (and
+    any ``skip_prefixes`` file-path match) stripped off the inner end."""
+    stack = traceback.extract_stack(limit=limit)
+    while stack and any(
+        p in stack[-1].filename for p in skip_prefixes
+    ):
+        stack.pop()
+    return stack[-8:]

@@ -1,0 +1,83 @@
+"""The master's known shared singletons: class name -> tracked fields.
+
+This is the auto-registration table behind ``shared(obj)`` (no explicit
+``fields=``) and :func:`auto_register`.  Fields listed here are the ones
+multiple threads actually touch — the servicer's RPC handler threads,
+the state-store coalescing thread, the agent's saver/monitor threads,
+and timer ticks all share these objects.
+
+Keep entries honest: a field only belongs here if concurrent access is
+*possible* in production, because every listed field pays proxy/hook
+overhead while the detector is enabled (and none when it is not).
+"""
+
+from __future__ import annotations
+
+KNOWN_SHARED: dict[str, tuple[str, ...]] = {
+    # common/telemetry.py — every hook in the process funnels here
+    "TelemetryRegistry": (
+        "_counters", "_gauges", "_hists", "_series", "_events",
+        "_sample_seq", "_seq", "_dropped",
+    ),
+    # master-side merge of agent snapshots (servicer threads + queries)
+    "JobTelemetry": ("_snaps",),
+    # master/metrics_store.py — ingest (RPC) vs query (HTTP) vs evict
+    "MetricsStore": ("_series",),
+    "SloWatchdog": ("_breaches", "_prev_dropped"),
+    # master/kvstore.py — workers' barrier store, written under load
+    "KVStoreService": ("_store", "_bytes", "evicted"),
+    "SyncService": ("_sync_objs", "_finished"),
+    # master/state_store.py — WAL appends (RPC threads) vs the
+    # coalescing snapshot thread
+    "MasterStateStore": ("_wal_seq", "_wal_lines", "snapshots_written"),
+    # master/servicer.py
+    "CheckpointBarrierService": ("_ready", "_aborted", "_persisted"),
+    "MasterServicer": ("_run_configs", "_marked_rounds", "_job_success"),
+    # master/rendezvous.py — joins vs heartbeat liveness vs drain
+    "RendezvousManager": (
+        "_waiting_nodes", "_rdzv_nodes", "_latest_rdzv_nodes",
+        "_rdzv_round", "_verified_steps", "_restore_step", "_carryover",
+        "_departed_pending", "_verdicts", "_departed", "_params",
+        "_first_join_time",
+    ),
+    # master/shard/dataset_manager.py — dispatch vs result vs recovery
+    "BatchDatasetManager": (
+        "todo", "doing", "_task_id", "_completed_step",
+    ),
+    "StreamingDatasetManager": (
+        "todo", "doing", "_task_id", "_completed_step",
+        "_next_record", "_reported", "_ended",
+    ),
+    # common/arena.py — checkpoint buffer pool (saver + trainer threads)
+    "HostArena": ("_free", "_pooled_bytes", "hits", "misses"),
+    # agent/ckpt_saver.py — trainer-side save vs agent-side persist
+    "AsyncCheckpointSaver": ("_last_persisted_step",),
+}
+
+# RendezvousManager subclasses share the base field set
+for _sub in (
+    "ElasticTrainingRendezvousManager",
+    "NetworkCheckRendezvousManager",
+):
+    KNOWN_SHARED[_sub] = KNOWN_SHARED["RendezvousManager"]
+
+
+def auto_register() -> int:
+    """Register the live process-global singletons (telemetry registry,
+    host arena) with the enabled detector.  Returns how many objects
+    were registered; strict no-op (returns 0) when dtsan is disabled."""
+    from tools.dtsan.runtime import active_detector, shared
+
+    if active_detector() is None:
+        return 0
+    count = 0
+    from dlrover_tpu.common import arena, telemetry
+
+    reg = telemetry.active_registry()
+    if reg is not None:
+        shared(reg)
+        count += 1
+    if arena._ARENA is not None:
+        shared(arena._ARENA)
+        count += 1
+    return count

@@ -1,0 +1,801 @@
+"""dtsan runtime: instrumented sync primitives + shared-field tracking.
+
+``enable()`` patches the *construction sites* of the project's sync
+primitives — ``threading.Lock/RLock/Condition/Event/Thread`` become
+factories that return instrumented wrappers only when the constructing
+frame lives in a registered module prefix (default ``dlrover_tpu``).
+Everything else (pytest, jax, stdlib queue, ...) keeps getting the real
+primitives.  Mirroring the chaos/telemetry guard idiom, the whole
+machinery is a strict no-op unless enabled: nothing is patched, every
+hook is a module-global load plus an ``is None`` branch.
+
+``shared(obj, fields=...)`` registers an object's fields with the
+detector: container-valued fields are replaced with tracked subclasses
+that report item reads/writes, scalar fields are watched through
+class-level ``__getattribute__``/``__setattr__`` hooks.  Unsynchronized
+cross-thread access to a registered field produces a :class:`Race`
+carrying both stacks.
+
+Known limitations (documented in docs/DESIGN.md "Concurrency model"):
+
+- HB edges come only from *instrumented* primitives.  Sync through
+  un-instrumented channels (stdlib ``queue.Queue``, socket round-trips,
+  ``subprocess``) is invisible — accesses ordered that way report as
+  races and need an in-code fix, a ``shared()`` exclusion, or an
+  instrumented primitive on the path.
+- Locks constructed *before* ``enable()`` are not wrapped; race
+  scenarios construct their subsystems after enabling.
+- Tracking is per registered field, not whole-heap: the detector only
+  sees what ``shared()``/``auto_register()`` told it about.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from collections import deque
+
+from tools.dtsan.clocks import (
+    Access,
+    Race,
+    VarState,
+    VectorClock,
+    capture_stack,
+)
+
+# real primitives, captured at import so wrappers and the detector's own
+# bookkeeping can never recurse into the patched factories
+_ORIG = {
+    "Lock": threading.Lock,
+    "RLock": threading.RLock,
+    "Condition": threading.Condition,
+    "Event": threading.Event,
+    "Thread": threading.Thread,
+}
+
+# stack frames from these path fragments are stripped from race reports
+_OWN_FRAMES = ("tools/dtsan/", "tools\\dtsan\\")
+
+_DET: "Detector | None" = None
+_SCHED = None  # active cooperative scheduler (set by tools.dtsan.sched)
+
+
+def _set_scheduler(sched):
+    global _SCHED
+    _SCHED = sched
+
+
+def active_scheduler():
+    return _SCHED
+
+
+def active_detector() -> "Detector | None":
+    return _DET
+
+
+def _caller_module(depth: int = 2) -> str:
+    """Module name of the constructing frame, skipping dtsan's own
+    wrappers.  A construction from *inside* the threading module
+    (Thread.__init__'s ``_started`` event, ``_DummyThread``, Timer)
+    reports "" — stdlib internals must always get real primitives."""
+    try:
+        f = sys._getframe(depth)
+    except ValueError:
+        return ""
+    while f is not None:
+        mod = f.f_globals.get("__name__", "")
+        if mod == "threading":
+            return ""
+        if not mod.startswith("tools.dtsan"):
+            return mod
+        f = f.f_back
+    return ""
+
+
+def _instrument_here(depth: int = 3) -> bool:
+    det = _DET
+    if det is None:
+        return False
+    mod = _caller_module(depth)
+    return mod.startswith(det.prefixes)
+
+
+# -------------------------------------------------------------------------
+# thread state
+# -------------------------------------------------------------------------
+
+
+class ThreadState:
+    __slots__ = ("tid", "vc", "name")
+
+    def __init__(self, tid: int, name: str, vc: VectorClock):
+        self.tid = tid
+        self.name = name
+        self.vc = vc
+
+
+class Detector:
+    """Process-global happens-before race detector state."""
+
+    def __init__(self, prefixes: tuple[str, ...]):
+        self.prefixes = tuple(prefixes)
+        self._ilock = _ORIG["Lock"]()
+        self._next_tid = 1
+        self._threads: dict[int, ThreadState] = {}  # ident -> state
+        self._vars: dict[tuple[int, str], VarState] = {}
+        self._objs: dict[int, object] = {}  # strong refs: id() stays valid
+        self._patched_classes: dict[type, tuple] = {}
+        self._wrapped: list[tuple[object, str]] = []
+        self._races: list[Race] = []
+        self._race_keys: set = set()
+
+    # --------------------------------------------------------- thread clocks
+
+    def _state_locked(self) -> ThreadState:
+        ident = threading.get_ident()
+        st = self._threads.get(ident)
+        if st is None:
+            tid = self._next_tid
+            self._next_tid += 1
+            vc = VectorClock()
+            vc.advance(tid)
+            st = ThreadState(tid, threading.current_thread().name, vc)
+            self._threads[ident] = st
+        return st
+
+    def on_thread_created(self) -> VectorClock:
+        """Parent side of a fork: snapshot, then advance (the snapshot
+        and everything after the fork are different epochs)."""
+        with self._ilock:
+            st = self._state_locked()
+            birth = st.vc.copy()
+            st.vc.advance(st.tid)
+            return birth
+
+    def on_thread_started(self, birth: VectorClock | None):
+        """Child side: inherit the parent's snapshot."""
+        with self._ilock:
+            ident = threading.get_ident()
+            tid = self._next_tid
+            self._next_tid += 1
+            vc = birth.copy() if birth is not None else VectorClock()
+            vc.advance(tid)
+            self._threads[ident] = ThreadState(
+                tid, threading.current_thread().name, vc
+            )
+
+    def on_thread_exit(self) -> VectorClock:
+        with self._ilock:
+            st = self._state_locked()
+            final = st.vc.copy()
+            # idents are reused by the OS; drop the mapping now
+            self._threads.pop(threading.get_ident(), None)
+            return final
+
+    def on_thread_joined(self, final_vc: VectorClock):
+        with self._ilock:
+            self._state_locked().vc.join(final_vc)
+
+    # ----------------------------------------------------------- sync clocks
+
+    def on_acquire(self, clock: VectorClock):
+        with self._ilock:
+            self._state_locked().vc.join(clock)
+
+    def on_release(self, clock: VectorClock):
+        with self._ilock:
+            st = self._state_locked()
+            clock.join(st.vc)
+            st.vc.advance(st.tid)
+
+    # -------------------------------------------------------- variable model
+
+    def register(self, obj, field: str, name: str):
+        key = (id(obj), field)
+        with self._ilock:
+            if key not in self._vars:
+                self._vars[key] = VarState(name)
+                self._objs[id(obj)] = obj
+
+    def on_var_access(self, key: tuple, write: bool):
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            var = self._vars.get(key)
+            sched.yield_point(
+                "var.write" if write else "var.read",
+                var.name if var is not None else "?",
+            )
+        with self._ilock:
+            var = self._vars.get(key)
+            if var is None:
+                return  # stale container from a previous enable window
+            st = self._state_locked()
+            stack = capture_stack(_OWN_FRAMES)
+            acc = Access(st.tid, st.vc.get(st.tid, 0), st.name, stack,
+                         write)
+            w = var.last_write
+            if write:
+                if w is not None and w.tid != st.tid and not \
+                        st.vc.covers(w.tid, w.clock):
+                    self._report(var, "write-write", w, acc)
+                for r in var.reads.values():
+                    if r.tid != st.tid and not st.vc.covers(
+                        r.tid, r.clock
+                    ):
+                        self._report(var, "read-write", r, acc)
+                var.last_write = acc
+                var.reads.clear()
+            else:
+                if w is not None and w.tid != st.tid and not \
+                        st.vc.covers(w.tid, w.clock):
+                    self._report(var, "write-read", w, acc)
+                var.reads[st.tid] = acc
+
+    def _report(self, var: VarState, kind: str, prior: Access,
+                current: Access):
+        race = Race(var.name, kind, prior, current)
+        if race.key in self._race_keys:
+            return
+        self._race_keys.add(race.key)
+        self._races.append(race)
+
+    # ------------------------------------------------------------- reporting
+
+    def races(self) -> list[Race]:
+        with self._ilock:
+            return list(self._races)
+
+    def reset(self):
+        """Clear variables, races, and thread clocks, keeping the
+        patches — the explorer calls this between schedules.  Wrapped
+        containers from the previous schedule are unwrapped here too:
+        _wrapped holds strong refs, and a long sweep must not pin every
+        schedule's dead subsystems until disable()."""
+        self._unwrap_all()
+        with self._ilock:
+            self._vars.clear()
+            self._objs.clear()
+            self._races.clear()
+            self._race_keys.clear()
+            self._threads.clear()
+
+    # ------------------------------------------------- class instrumentation
+
+    def maybe_wrap(self, value, key: tuple):
+        wrapper = _CONTAINERS.get(type(value))
+        if wrapper is None:
+            return value
+        if type(value) is deque:
+            wrapped = wrapper(value, maxlen=value.maxlen)
+        else:
+            wrapped = wrapper(value)
+        wrapped._dt_key = key
+        return wrapped
+
+    def instrument_class(self, cls: type):
+        if cls in self._patched_classes:
+            return
+        orig_get = cls.__getattribute__
+        orig_set = cls.__setattr__
+
+        def _dt_get(obj, attr, _orig=orig_get):
+            value = _orig(obj, attr)
+            det = _DET
+            if det is not None and (id(obj), attr) in det._vars:
+                det.on_var_access((id(obj), attr), write=False)
+            return value
+
+        def _dt_set(obj, attr, value, _orig=orig_set):
+            det = _DET
+            if det is not None and (id(obj), attr) in det._vars:
+                wrapped = det.maybe_wrap(value, (id(obj), attr))
+                if wrapped is not value:
+                    value = wrapped
+                    det._wrapped.append((obj, attr))
+                det.on_var_access((id(obj), attr), write=True)
+            _orig(obj, attr, value)
+
+        cls.__getattribute__ = _dt_get  # type: ignore[method-assign]
+        cls.__setattr__ = _dt_set  # type: ignore[method-assign]
+        self._patched_classes[cls] = (orig_get, orig_set)
+
+    def restore_classes(self):
+        for cls, (orig_get, orig_set) in self._patched_classes.items():
+            cls.__getattribute__ = orig_get  # type: ignore[method-assign]
+            cls.__setattr__ = orig_set  # type: ignore[method-assign]
+        self._patched_classes.clear()
+        self._unwrap_all()
+
+    def _unwrap_all(self):
+        """Replace tracked containers with plain ones (rebuilt from
+        CURRENT contents — mutations made while wrapped must survive)
+        and drop the strong refs."""
+        for obj, field in self._wrapped:
+            try:
+                cur = object.__getattribute__(obj, field)
+            except AttributeError:
+                continue
+            for base in _CONTAINERS:
+                if isinstance(cur, base) and type(cur) is not base:
+                    plain = (
+                        base(cur, maxlen=cur.maxlen)
+                        if base is deque else base(cur)
+                    )
+                    object.__setattr__(obj, field, plain)
+                    break
+        self._wrapped.clear()
+
+
+# -------------------------------------------------------------------------
+# tracked containers
+# -------------------------------------------------------------------------
+
+
+def _rec(container, write: bool):
+    det = _DET
+    if det is None:
+        return
+    key = getattr(container, "_dt_key", None)
+    if key is not None:
+        det.on_var_access(key, write)
+
+
+def _make_container(base, reads, writes, extra_slots=()):
+    """Build a tracked subclass of ``base`` reporting the named methods
+    as reads/writes of the registered field."""
+
+    namespace = {"_dt_key": None}
+
+    def make(method_name, write):
+        orig = getattr(base, method_name)
+
+        def op(self, *a, _orig=orig, _write=write, **k):
+            _rec(self, _write)
+            return _orig(self, *a, **k)
+
+        op.__name__ = method_name
+        return op
+
+    for m in reads:
+        namespace[m] = make(m, write=False)
+    for m in writes:
+        namespace[m] = make(m, write=True)
+    return type(f"Tracked{base.__name__.capitalize()}", (base,),
+                namespace)
+
+
+TrackedDict = _make_container(
+    dict,
+    reads=("__getitem__", "get", "__contains__", "__iter__", "__len__",
+           "keys", "values", "items", "copy"),
+    writes=("__setitem__", "__delitem__", "pop", "popitem", "clear",
+            "update", "setdefault"),
+)
+TrackedList = _make_container(
+    list,
+    reads=("__getitem__", "__iter__", "__len__", "__contains__",
+           "index", "count", "copy"),
+    writes=("__setitem__", "__delitem__", "append", "extend", "insert",
+            "remove", "pop", "clear", "sort", "reverse", "__iadd__"),
+)
+TrackedSet = _make_container(
+    set,
+    reads=("__contains__", "__iter__", "__len__", "copy"),
+    writes=("add", "discard", "remove", "pop", "clear", "update",
+            "__ior__", "__isub__", "difference_update"),
+)
+TrackedDeque = _make_container(
+    deque,
+    reads=("__getitem__", "__iter__", "__len__", "copy"),
+    writes=("append", "appendleft", "extend", "extendleft", "pop",
+            "popleft", "remove", "clear", "rotate"),
+)
+
+_CONTAINERS = {
+    dict: TrackedDict,
+    list: TrackedList,
+    set: TrackedSet,
+    deque: TrackedDeque,
+}
+
+
+# -------------------------------------------------------------------------
+# instrumented primitives
+# -------------------------------------------------------------------------
+
+
+class TrackedLock:
+    """Wrapper over a real lock carrying a release clock.  Also wraps
+    arbitrary lock-shaped objects via :func:`wrap_lock`."""
+
+    _dt_reentrant = False
+
+    def __init__(self, real=None, name: str = "lock"):
+        self._real = real if real is not None else _ORIG["Lock"]()
+        self._dt_clock = VectorClock()
+        self._dt_name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            sched.yield_point("lock.acquire", self._dt_name)
+            # a bounded acquire keeps its can-time-out semantics under
+            # the explorer (snapshot_best_effort's degrade path must
+            # stay explorable, not report a bogus deadlock)
+            ok = sched.coop_acquire(
+                self._real, blocking,
+                timed=timeout not in (-1, None),
+            )
+        elif timeout == -1:
+            ok = self._real.acquire(blocking)
+        else:
+            ok = self._real.acquire(blocking, timeout)
+        if ok:
+            det = _DET
+            if det is not None:
+                det.on_acquire(self._dt_clock)
+        return ok
+
+    def release(self):
+        det = _DET
+        if det is not None:
+            det.on_release(self._dt_clock)
+        self._real.release()
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            sched.yield_point("lock.release", self._dt_name)
+
+    def locked(self):
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self._dt_name!r}>"
+
+
+class TrackedRLock(TrackedLock):
+    """Reentrant: clock hooks fire only on the outermost transition."""
+
+    _dt_reentrant = True
+
+    def __init__(self, name: str = "rlock"):
+        super().__init__(_ORIG["RLock"](), name)
+        self._dt_owner: int | None = None
+        self._dt_count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = threading.get_ident()
+        if self._dt_owner == me:
+            ok = self._real.acquire(blocking)  # recursive: cannot block
+            if ok:
+                self._dt_count += 1
+            return ok
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            sched.yield_point("lock.acquire", self._dt_name)
+            # _thread.RLock has no .locked() before 3.14: probe via the
+            # wrapper's own owner bookkeeping (scheduler-serialized, so
+            # it is exact here)
+            ok = sched.coop_acquire(
+                self._real, blocking,
+                is_free=lambda: self._dt_count == 0,
+                timed=timeout not in (-1, None),
+            )
+        elif timeout == -1:
+            ok = self._real.acquire(blocking)
+        else:
+            ok = self._real.acquire(blocking, timeout)
+        if ok:
+            self._dt_owner = me
+            self._dt_count = 1
+            det = _DET
+            if det is not None:
+                det.on_acquire(self._dt_clock)
+        return ok
+
+    def release(self):
+        if self._dt_owner == threading.get_ident() and self._dt_count > 1:
+            self._dt_count -= 1
+            self._real.release()
+            return
+        self._dt_owner = None
+        self._dt_count = 0
+        super().release()
+
+    def locked(self):
+        return self._dt_count > 0
+
+    def _is_owned(self):
+        return self._dt_owner == threading.get_ident()
+
+
+class TrackedCondition:
+    """Condition over a (tracked) lock, with a notify->wait clock."""
+
+    def __init__(self, lock=None, name: str = "cond"):
+        if lock is None:
+            lock = TrackedLock(name=f"{name}.lock")
+        self._lock = lock
+        self._real = _ORIG["Condition"](lock)
+        self._dt_clock = VectorClock()
+        self._dt_name = name
+        self._dt_waiters: list[dict] = []
+
+    # lock protocol (delegated so ``with cond:`` works)
+    def acquire(self, *a, **k):
+        return self._lock.acquire(*a, **k)
+
+    def release(self):
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout: float | None = None) -> bool:
+        det = _DET
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            entry = {"notified": False}
+            self._dt_waiters.append(entry)
+            self.release()
+            ok = sched.coop_wait(
+                lambda: entry["notified"], timed=timeout is not None,
+                what=f"{self._dt_name}.wait",
+            )
+            self.acquire()
+            if not ok and entry in self._dt_waiters:
+                self._dt_waiters.remove(entry)
+        else:
+            ok = self._real.wait(timeout)
+        if ok and det is not None:
+            det.on_acquire(self._dt_clock)
+        return ok
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        # stdlib contract: ``timeout`` bounds TOTAL elapsed time, so
+        # each re-wait gets only the remaining budget — re-waiting the
+        # full timeout would make a notify-heavy wait unbounded
+        import time as _time
+
+        endtime = (
+            None if timeout is None else _time.monotonic() + timeout
+        )
+        result = predicate()
+        while not result:
+            waittime = None
+            if endtime is not None:
+                waittime = endtime - _time.monotonic()
+                if waittime <= 0:
+                    return predicate()
+            if not self.wait(waittime):
+                return predicate()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1):
+        det = _DET
+        if det is not None:
+            det.on_release(self._dt_clock)
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            for entry in self._dt_waiters[:n]:
+                entry["notified"] = True
+            del self._dt_waiters[:n]
+        else:
+            self._real.notify(n)
+
+    def notify_all(self):
+        self.notify(n=len(self._dt_waiters) or 1 << 30)
+
+
+class TrackedEvent:
+    """Event whose ``set()`` happens-before any ``wait()``/``is_set()``
+    that observes it."""
+
+    def __init__(self, name: str = "event"):
+        self._real = _ORIG["Event"]()
+        self._dt_clock = VectorClock()
+        self._dt_name = name
+
+    def set(self):
+        det = _DET
+        if det is not None:
+            det.on_release(self._dt_clock)
+        self._real.set()
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            sched.yield_point("event.set", self._dt_name)
+
+    def clear(self):
+        self._real.clear()
+
+    def is_set(self) -> bool:
+        v = self._real.is_set()
+        if v:
+            det = _DET
+            if det is not None:
+                det.on_acquire(self._dt_clock)
+        return v
+
+    def wait(self, timeout: float | None = None) -> bool:
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            ok = sched.coop_wait(
+                self._real.is_set, timed=timeout is not None,
+                what=f"{self._dt_name}.wait",
+            )
+        else:
+            ok = self._real.wait(timeout)
+        if ok:
+            det = _DET
+            if det is not None:
+                det.on_acquire(self._dt_clock)
+        return ok
+
+
+class TrackedThread(_ORIG["Thread"]):
+    """Thread whose fork/join edges reach the detector.  Instances
+    created from non-registered modules behave exactly like real
+    threads (every hook is gated on the creation-site check)."""
+
+    def __init__(self, *args, **kwargs):
+        # explicit base call, not super(): while threading.Thread is
+        # patched, stdlib internals (_DummyThread, Timer) resolve the
+        # name ``Thread`` to this class and call __init__ with SELF
+        # being a real-Thread subclass that is not a TrackedThread
+        _ORIG["Thread"].__init__(self, *args, **kwargs)
+        self._dt_tracked = _instrument_here(depth=2)
+        self._dt_birth: VectorClock | None = None
+        self._dt_final: VectorClock | None = None
+
+    def start(self):
+        det = _DET
+        if det is not None and self._dt_tracked:
+            self._dt_birth = det.on_thread_created()
+        super().start()
+
+    def run(self):
+        det = _DET
+        if det is not None and self._dt_tracked:
+            det.on_thread_started(self._dt_birth)
+        try:
+            super().run()
+        finally:
+            det = _DET
+            if det is not None and self._dt_tracked:
+                self._dt_final = det.on_thread_exit()
+
+    def join(self, timeout: float | None = None):
+        sched = _SCHED
+        if sched is not None and sched.participating():
+            sched.coop_wait(
+                lambda: not self.is_alive(), timed=timeout is not None,
+                what=f"join({self.name})",
+            )
+            super().join(0.0 if timeout is not None else None)
+        else:
+            super().join(timeout)
+        det = _DET
+        if det is not None and not self.is_alive() and \
+                self._dt_final is not None:
+            det.on_thread_joined(self._dt_final)
+
+
+# -------------------------------------------------------------------------
+# construction-site factories
+# -------------------------------------------------------------------------
+
+
+def _lock_factory():
+    if _instrument_here():
+        return TrackedLock(name=f"lock@{_caller_module()}")
+    return _ORIG["Lock"]()
+
+
+def _rlock_factory():
+    if _instrument_here():
+        return TrackedRLock(name=f"rlock@{_caller_module()}")
+    return _ORIG["RLock"]()
+
+
+def _condition_factory(lock=None):
+    if _instrument_here() or isinstance(lock, TrackedLock):
+        return TrackedCondition(lock, name=f"cond@{_caller_module()}")
+    if lock is None:
+        return _ORIG["Condition"]()
+    return _ORIG["Condition"](lock)
+
+
+def _event_factory():
+    if _instrument_here():
+        return TrackedEvent(name=f"event@{_caller_module()}")
+    return _ORIG["Event"]()
+
+
+def wrap_lock(real, name: str = "wrapped-lock") -> TrackedLock:
+    """Instrument an arbitrary lock-shaped object (``acquire``/
+    ``release``) — e.g. an IPC :class:`SharedLock` — so its critical
+    sections contribute happens-before edges."""
+    return TrackedLock(real, name=name)
+
+
+# -------------------------------------------------------------------------
+# enable / disable / shared
+# -------------------------------------------------------------------------
+
+
+def enable(prefixes=("dlrover_tpu",)) -> Detector:
+    """Arm the detector and patch the construction sites.  Idempotent;
+    returns the active detector."""
+    global _DET
+    if _DET is not None:
+        return _DET
+    _DET = Detector(tuple(prefixes))
+    threading.Lock = _lock_factory  # type: ignore[assignment]
+    threading.RLock = _rlock_factory  # type: ignore[assignment]
+    threading.Condition = _condition_factory  # type: ignore[assignment]
+    threading.Event = _event_factory  # type: ignore[assignment]
+    threading.Thread = TrackedThread  # type: ignore[misc]
+    return _DET
+
+
+def disable():
+    """Restore every patched construction site and class; drop state."""
+    global _DET
+    det = _DET
+    if det is None:
+        return
+    threading.Lock = _ORIG["Lock"]  # type: ignore[assignment]
+    threading.RLock = _ORIG["RLock"]  # type: ignore[assignment]
+    threading.Condition = _ORIG["Condition"]  # type: ignore[assignment]
+    threading.Event = _ORIG["Event"]  # type: ignore[assignment]
+    threading.Thread = _ORIG["Thread"]  # type: ignore[misc]
+    det.restore_classes()
+    _DET = None
+
+
+def shared(obj, fields=None, name: str | None = None):
+    """Register ``obj``'s fields for race tracking.  ``fields=None``
+    looks the class up in the known-singleton table
+    (:data:`tools.dtsan.known.KNOWN_SHARED`).  Strict no-op when the
+    detector is disabled.  Returns ``obj``."""
+    det = _DET
+    if det is None:
+        return obj
+    cls = type(obj)
+    if fields is None:
+        from tools.dtsan.known import KNOWN_SHARED
+
+        fields = KNOWN_SHARED.get(cls.__name__)
+        if fields is None:
+            raise ValueError(
+                f"{cls.__name__} is not in the known-shared table; "
+                f"pass fields=... explicitly"
+            )
+    base = name or cls.__name__
+    for field in fields:
+        try:
+            value = object.__getattribute__(obj, field)
+        except AttributeError:
+            raise ValueError(
+                f"{cls.__name__} has no field {field!r}"
+            ) from None
+        det.register(obj, field, f"{base}.{field}")
+        wrapped = det.maybe_wrap(value, (id(obj), field))
+        if wrapped is not value:
+            object.__setattr__(obj, field, wrapped)
+            det._wrapped.append((obj, field))
+    det.instrument_class(cls)
+    return obj

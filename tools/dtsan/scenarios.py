@@ -1,0 +1,406 @@
+"""Named race scenarios over the REAL control-plane subsystems.
+
+Each scenario is a ``make()`` factory (the explorer protocol: returns
+``(thunks, check)`` over freshly constructed state) plus a description.
+The same factories back three consumers:
+
+- ``tests/test_race_subsystems.py`` — tier-1 ``race``-marked coverage
+  in both modes (real-thread detector runs, bounded explorer sweeps);
+- ``tools/race_run.py`` — the operator CLI (``--list``, ``--mode``);
+- ad-hoc debugging (``dtsan.replay(SCENARIOS[name].make, seed)``).
+
+Scenario rules:
+
+- construct every subsystem INSIDE ``make()`` (locks built after
+  ``dtsan.enable()`` are the instrumented ones);
+- ``check()`` asserts schedule-independent invariants only (totals,
+  bounds, exactly-once counts) — anything interleaving-dependent is
+  the detector's job, not the check's;
+- keep thunks small: the explorer's schedule space is exponential in
+  yield points, and the tier-1 budget is seconds per scenario.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+
+from tools import dtsan
+
+
+class Scenario:
+    def __init__(self, name: str, desc: str, make):
+        self.name = name
+        self.desc = desc
+        self.make = make
+
+    def run_detect(self):
+        """One real-thread (non-explorer) run: returns (races, None) or
+        (races, check_error)."""
+        det = dtsan.active_detector()
+        if det is not None:
+            det.reset()
+        thunks, check = self.make()
+        run_threads(thunks)
+        err = None
+        if check is not None:
+            try:
+                check()
+            except Exception as e:  # noqa: BLE001 - reported to caller
+                err = e
+        return dtsan.races(), err
+
+
+def run_threads(thunks, join_timeout: float = 60.0):
+    """Run thunks on TRACKED threads and join them: the fork/join
+    happens-before edges make the driver's post-run reads (the check)
+    visible to the detector, exactly like a parent thread's would be."""
+    from tools.dtsan.runtime import TrackedThread, active_detector
+
+    threads = []
+    for i, fn in enumerate(thunks):
+        t = TrackedThread(
+            target=fn, name=f"dtsan-worker-{i}", daemon=True
+        )
+        t._dt_tracked = active_detector() is not None
+        threads.append(t)
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(join_timeout)
+
+
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def _scenario(name: str, desc: str):
+    def deco(fn):
+        SCENARIOS[name] = Scenario(name, desc, fn)
+        return fn
+    return deco
+
+
+def _fresh_dir(tag: str) -> str:
+    """A per-scenario scratch dir, recycled across schedules (schedules
+    run strictly sequentially)."""
+    path = os.path.join(tempfile.gettempdir(), f"dtsan_{tag}")
+    shutil.rmtree(path, ignore_errors=True)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+# ------------------------------------------------------------------------
+# metrics store: concurrent ingest / query / evict
+# ------------------------------------------------------------------------
+
+
+@_scenario(
+    "metrics-ingest",
+    "metrics store: snapshot ingest from two sources racing queries "
+    "and the stalest-series eviction at a tiny cap",
+)
+def make_metrics_ingest():
+    from dlrover_tpu.master.metrics_store import MetricsStore
+
+    store = MetricsStore(raw_maxlen=8, max_series=3)
+    dtsan.shared(store)
+
+    def snap(source, base, n=4):
+        return {
+            "source": source,
+            "series": [{
+                "name": f"g{j}",
+                "labels": {},
+                "points": [
+                    [base + i, 100.0 + base + i, 0.0, float(i)]
+                    for i in range(n)
+                ],
+            } for j in range(2)],
+        }
+
+    ingested = []
+
+    def ingest_a():
+        ingested.append(store.ingest_snapshot(snap("host-a", 1)))
+        ingested.append(store.ingest_snapshot(snap("host-a", 1)))  # dup
+
+    def ingest_b():
+        ingested.append(store.ingest_snapshot(snap("host-b", 1)))
+
+    def query():
+        store.query("g0", resolution="raw")
+        store.latest("g1")
+        store.names()
+
+    def check():
+        # schedule-independent invariants only: each fresh snapshot
+        # lands its 8 points exactly once, and the re-sent host-a
+        # snapshot adds points ONLY for series the cap evicted in
+        # between (an evicted series losing its high-water mark and
+        # re-filling is by design) — so the total is 16 plus 4 per
+        # evicted-then-refilled host-a series, never anything else
+        assert sum(ingested) in (16, 20, 24), ingested
+        assert len(store._series) <= 3
+
+    return [ingest_a, ingest_b, query], check
+
+
+# ------------------------------------------------------------------------
+# master state store: WAL appends vs snapshot coalescing
+# ------------------------------------------------------------------------
+
+
+@_scenario(
+    "wal-vs-snapshot",
+    "state store: concurrent WAL appends racing a coalesced snapshot "
+    "write (high-water mark capture) and the kv WAL hook",
+)
+def make_wal_vs_snapshot():
+    from dlrover_tpu.master.kvstore import KVStoreService
+    from dlrover_tpu.master.state_store import MasterStateStore
+
+    state_dir = _fresh_dir("wal")
+    store = MasterStateStore(state_dir)
+    kv = KVStoreService(max_entries=64)
+    store.bind(kv_store=kv)
+    dtsan.shared(store)
+    dtsan.shared(kv)
+
+    def append_a():
+        for i in range(3):
+            store.wal_append("kv", key=f"a{i}", value="QQ==")
+
+    def append_kv():
+        # the servicer's kv path: WAL record under the kv lock
+        for i in range(3):
+            kv.set(f"b{i}", b"x", wal=store.wal_append)
+
+    def snapshotter():
+        store.write_snapshot()
+        store.write_snapshot()
+
+    def check():
+        with open(store._wal_path, encoding="utf-8") as f:
+            lines = [ln for ln in f if ln.strip()]
+        assert len(lines) == 6, len(lines)
+        assert store._wal_seq == 6
+        snap = store.load()
+        assert snap is not None and 0 <= snap["wal_seq"] <= 6
+
+    return [append_a, append_kv, snapshotter], check
+
+
+# ------------------------------------------------------------------------
+# kv store: eviction under writers
+# ------------------------------------------------------------------------
+
+
+@_scenario(
+    "kvstore-evict",
+    "kv store: two writers forcing insertion-order eviction at a tiny "
+    "cap, racing get/add/delete",
+)
+def make_kvstore_evict():
+    from dlrover_tpu.master.kvstore import KVStoreService
+
+    kv = KVStoreService(max_entries=2, max_bytes=1 << 20)
+    dtsan.shared(kv)
+
+    def writer_a():
+        for i in range(3):
+            kv.set(f"a{i}", b"x" * 8)
+
+    def writer_b():
+        kv.set("b0", b"y" * 8)
+        kv.add("ctr", 2)
+        kv.delete("a0")
+
+    def reader():
+        kv.get("a1")
+        kv.get("ctr")
+
+    def check():
+        assert len(kv._store) <= 2
+        assert kv._bytes == sum(
+            len(k) + len(v) for k, v in kv._store.items()
+        )
+
+    return [writer_a, writer_b, reader], check
+
+
+# ------------------------------------------------------------------------
+# rendezvous: round formation vs heartbeats vs drain
+# ------------------------------------------------------------------------
+
+
+@_scenario(
+    "rendezvous-round",
+    "rendezvous: joins and round formation racing the heartbeat "
+    "liveness path (remove_alive_node) and a graceful drain",
+)
+def make_rendezvous_round():
+    from dlrover_tpu.master.rendezvous import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(
+        min_nodes=2, max_nodes=2, waiting_timeout=0.0, node_unit=1
+    )
+    dtsan.shared(mgr)
+
+    def joiner_0():
+        mgr.join_rendezvous(0, 1, "10.0.0.1", verified_ckpt_steps=[5])
+        mgr.get_comm_world(0)
+
+    def joiner_1():
+        mgr.join_rendezvous(1, 1, "10.0.0.2", verified_ckpt_steps=[5])
+        mgr.get_comm_world(1)
+
+    def lifecycle():
+        mgr.num_nodes_waiting()
+        mgr.drain_node(2)            # not a member: must be a no-op
+        mgr.remove_alive_node(3)     # dead non-member: ditto
+        mgr.rdzv_round()
+
+    def check():
+        verdicts, departed = mgr.round_verdicts()
+        # whatever the interleaving, a formed round owns its members
+        # exclusively and non-members never produce verdicts
+        with mgr._lock:
+            overlap = set(mgr._rdzv_nodes) & set(mgr._waiting_nodes)
+        assert not overlap, overlap
+        assert set(verdicts) <= {0, 1}
+        assert set(departed) <= {2, 3}
+
+    return [joiner_0, joiner_1, lifecycle], check
+
+
+# ------------------------------------------------------------------------
+# ckpt saver: shm-lock handoff between trainer save and agent persist
+# ------------------------------------------------------------------------
+
+
+@_scenario(
+    "ckpt-shm-handoff",
+    "flash checkpoint: the trainer-side shm write and the agent-side "
+    "persist handing off the shared shm lock (never read unlocked)",
+)
+def make_ckpt_shm_handoff():
+    from dlrover_tpu.agent.ckpt_saver import (
+        CheckpointMeta,
+        LeafMeta,
+        SharedMemoryHandler,
+    )
+    from dlrover_tpu.common.ipc import SharedLock
+
+    raw = SharedLock(name=f"dtsan_shm_{os.getpid()}", create=True)
+    lock = dtsan.wrap_lock(raw, name="shm-lock")
+    writer_h = SharedMemoryHandler(local_rank=7)
+    reader_h = SharedMemoryHandler(local_rank=7)
+    observed: list[tuple[int, bytes]] = []
+    skipped: list[str] = []
+
+    def save(step: int):
+        payload = bytes([step]) * 16
+        meta = CheckpointMeta(
+            step=step,
+            leaves=[LeafMeta("w", "uint8", (16,), 0, 16)],
+            total_bytes=16,
+        )
+        if not lock.acquire(blocking=False):
+            skipped.append(f"save-{step}")
+            return
+        try:
+            view = writer_h.write_meta_and_reserve(meta, publish=False)
+            view[:] = payload
+            writer_h.publish_meta()
+        finally:
+            lock.release()
+
+    def persist():
+        # the saver's rule: NEVER read shm unlocked — a live writer may
+        # be mid-copy (ckpt_saver._sync_shm_to_storage)
+        if not lock.acquire(blocking=False):
+            skipped.append("persist")
+            return
+        try:
+            result = reader_h.read()
+            if result is not None:
+                meta, view = result
+                observed.append((meta.step, bytes(view[:16])))
+        finally:
+            lock.release()
+
+    def check():
+        # torn-read detector: anything persisted must be a fully
+        # published step (uniform payload matching its meta)
+        for step, payload in observed:
+            assert payload == bytes([step]) * 16, (step, payload)
+
+    thunks = [lambda: save(1), lambda: save(2), persist]
+
+    def final_check():
+        try:
+            check()
+        finally:
+            writer_h.close(unlink=True)
+            reader_h.close()
+            raw.unlink()
+
+    return thunks, final_check
+
+
+# ------------------------------------------------------------------------
+# telemetry: worker registry shipping vs master-side merge
+# ------------------------------------------------------------------------
+
+
+@_scenario(
+    "telemetry-ship",
+    "telemetry: a worker registry under live gauge/event writes racing "
+    "snapshot+delta shipping into the master's JobTelemetry merge and "
+    "metrics store",
+)
+def make_telemetry_ship():
+    from dlrover_tpu.common import telemetry
+    from dlrover_tpu.master.metrics_store import MetricsStore
+
+    # a FRESH registry constructed post-enable: its lock is instrumented
+    reg = telemetry.TelemetryRegistry(source="dtsan-worker")
+    job = telemetry.JobTelemetry()
+    store = MetricsStore(raw_maxlen=16)
+    dtsan.shared(reg)
+    dtsan.shared(job)
+    dtsan.shared(store)
+
+    def worker():
+        for i in range(4):
+            reg.gauge_set("train.step.last_s", 0.1 * (i + 1))
+            reg.event("step.end", step=i)
+
+    def shipper():
+        for _ in range(2):
+            snap = reg.snapshot()
+            assert job.update(snap)
+            store.ingest_snapshot(snap)
+
+    def querier():
+        job.snapshots()
+        job.merged_events()
+        store.latest("train.step.last_s")
+
+    def check():
+        # the final full snapshot is cumulative: one last ship must
+        # converge the master view no matter the interleaving
+        snap = reg.snapshot()
+        job.update(snap)
+        store.ingest_snapshot(snap)
+        merged = job.snapshots()
+        assert len(merged) == 1
+        assert len(merged[0]["events"]) == 4
+        series = store.query("train.step.last_s", resolution="raw")
+        assert len(series) == 1 and len(series[0]["points"]) == 4
+
+    return [worker, shipper, querier], check

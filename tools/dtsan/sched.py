@@ -1,0 +1,486 @@
+"""Deterministic schedule exploration for the Python control plane.
+
+A :class:`Scheduler` serializes its registered threads: exactly one
+runs at any moment, and at every instrumented *yield point* — lock
+ops, chaos sites, tracked shared-variable accesses, event sets — the
+running thread asks the scheduler who runs next.  The choice sequence
+is drawn from one seeded ``random.Random`` (with targeted
+preemption-bounding a la CHESS), so a schedule is a pure function of
+its seed: a failing interleaving replays exactly, like a chaos
+schedule, and can be minimized down to the preemption points that
+matter.
+
+Blocking is cooperative: an instrumented ``acquire``/``wait`` that
+cannot proceed parks the thread with a side-effect-free readiness
+predicate and hands the token to someone runnable.  All-threads-parked
+with no timed waiter is reported as a deadlock — itself a finding.
+
+Determinism contract: given a deterministic program (no wall-clock
+branching, no free-running helper threads), the trace — the sequence of
+``(thread, yield-kind, detail)`` tuples — and the failure are identical
+for the same seed.  ``explore()`` sweeps derived seeds; ``replay()``
+re-runs one; ``minimize()`` greedily drops preemptions while the
+failure reproduces.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from tools.dtsan import runtime
+from tools.dtsan.runtime import _ORIG
+
+
+class SchedulerError(RuntimeError):
+    pass
+
+
+class DeadlockError(SchedulerError):
+    """Every unfinished thread is parked and no timed wait can fire."""
+
+
+# how long block() polls a predicate that only an unscheduled
+# (non-participating) thread can satisfy before calling it a deadlock.
+# Short on purpose: a finishing free thread satisfies a join-pred in
+# milliseconds, while a GENUINE deadlock pays this stall on every
+# failing schedule (and minimize() re-runs many of them)
+_EXTERNAL_WAIT_TRIES = 250
+_EXTERNAL_WAIT_TICK = 0.001
+
+
+class _Entry:
+    __slots__ = (
+        "name", "gate", "thread", "blocked", "blocked_timed",
+        "timeout_fired", "finished", "error",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.gate = _ORIG["Event"]()
+        self.thread = None
+        self.blocked = None          # side-effect-free readiness pred
+        self.blocked_timed = False
+        self.timeout_fired = False
+        self.finished = False
+        self.error: BaseException | None = None
+
+
+class ScheduleResult:
+    """One schedule's outcome: the full trace plus any failure."""
+
+    def __init__(self, seed: int, preemption_bound: int | None = None):
+        self.seed = seed
+        # the bound this schedule RAN with — a replay must use this
+        # exact value, not the preemption count, or the RNG consumption
+        # in the forced-stay branch diverges
+        self.preemption_bound = preemption_bound
+        self.trace: list[tuple[str, str, str]] = []
+        self.decisions: list[str] = []
+        self.preemption_points: list[int] = []
+        self.error: BaseException | None = None
+        self.races: list = []
+
+    @property
+    def failed(self) -> bool:
+        return self.error is not None or bool(self.races)
+
+    def describe(self) -> str:
+        lines = [
+            f"schedule seed={self.seed}: "
+            f"{'FAIL' if self.failed else 'ok'} "
+            f"({len(self.trace)} yields, "
+            f"{len(self.preemption_points)} preemptions)"
+        ]
+        if self.error is not None:
+            lines.append(f"  error: {type(self.error).__name__}: "
+                         f"{self.error}")
+        for race in self.races:
+            lines.append("  " + race.format().replace("\n", "\n  "))
+        return "\n".join(lines)
+
+
+class Scheduler:
+    """Cooperative serializer for one schedule.  Not reusable."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        preemption_bound: int | None = None,
+        script: list[str] | None = None,
+        max_yields: int = 50_000,
+    ):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._bound = preemption_bound
+        self._script = script
+        self._max_yields = max_yields
+        self._entries: list[_Entry] = []
+        self._by_ident: dict[int, _Entry] = {}
+        self._done = _ORIG["Event"]()
+        self._abort = False
+        self._running = False
+        self.result = ScheduleResult(seed, preemption_bound)
+
+    # ------------------------------------------------------------ protocol
+
+    def participating(self) -> bool:
+        return self._running and (
+            threading.get_ident() in self._by_ident
+        )
+
+    def _me(self) -> _Entry:
+        return self._by_ident[threading.get_ident()]
+
+    def _pick_next(self, me: _Entry | None, can_stay: bool):
+        cands = []
+        for e in self._entries:
+            if e.finished:
+                continue
+            if e is me:
+                if can_stay:
+                    cands.append(e)
+                continue
+            if e.blocked is None or e.blocked():
+                cands.append(e)
+        if cands:
+            return self._select(cands, me, can_stay)
+        # nothing truly runnable: a parked TIMED waiter may fire its
+        # timeout — deterministically the first by name
+        timed = [
+            e for e in self._entries
+            if not e.finished and e is not me
+            and e.blocked is not None and e.blocked_timed
+        ]
+        if timed:
+            e = min(timed, key=lambda x: x.name)
+            e.timeout_fired = True
+            return e
+        return None
+
+    def _select(self, cands: list[_Entry], me, can_stay: bool):
+        cands.sort(key=lambda e: e.name)
+        if self._script is not None:
+            idx = len(self.result.decisions)
+            want = (
+                self._script[idx] if idx < len(self._script) else None
+            )
+            for e in cands:
+                if e.name == want:
+                    return e
+            if can_stay and me in cands:
+                return me
+            return cands[0]
+        if (
+            self._bound is not None
+            and len(self.result.preemption_points) >= self._bound
+            and can_stay and me in cands
+        ):
+            return me
+        return self._rng.choice(cands)
+
+    def yield_point(self, kind: str, detail: str = ""):
+        me = self._me()
+        self.result.trace.append((me.name, kind, detail))
+        if len(self.result.trace) > self._max_yields:
+            self._abort = True
+            raise SchedulerError(
+                f"schedule exceeded {self._max_yields} yield points "
+                f"(livelock?)"
+            )
+        nxt = self._pick_next(me, can_stay=True)
+        self.result.decisions.append(nxt.name)
+        if nxt is me:
+            return
+        # switching away from a runnable thread = a preemption
+        self.result.preemption_points.append(
+            len(self.result.decisions) - 1
+        )
+        self._handoff(me, nxt)
+
+    def block(self, pred, timed: bool = False, what: str = "") -> bool:
+        """Park until ``pred()`` (side-effect-free) holds.  Returns
+        False only for a ``timed`` wait whose turn came with nothing
+        else runnable — the deterministic analogue of a timeout."""
+        if pred():
+            return True
+        me = self._me()
+        me.blocked = pred
+        me.blocked_timed = timed
+        try:
+            nxt = self._pick_next(me, can_stay=False)
+            if nxt is None or nxt is me:
+                if timed:
+                    return False
+                # only an unscheduled thread can satisfy this (e.g.
+                # joining a free-running helper): poll for real
+                for _ in range(_EXTERNAL_WAIT_TRIES):
+                    if pred():
+                        return True
+                    time.sleep(_EXTERNAL_WAIT_TICK)
+                self._abort = True
+                raise DeadlockError(
+                    f"all threads parked while {me.name} waits on "
+                    f"{what or 'a predicate'}"
+                )
+            self.result.trace.append((me.name, "block", what))
+            self.result.decisions.append(nxt.name)
+            self._handoff(me, nxt)
+            if me.timeout_fired:
+                me.timeout_fired = False
+                return False
+            return True
+        finally:
+            me.blocked = None
+            me.blocked_timed = False
+
+    def coop_acquire(self, real, blocking: bool = True,
+                     is_free=None, timed: bool = False) -> bool:
+        """Cooperatively acquire ``real``.  ``is_free`` is the
+        side-effect-free readiness probe — callers must supply one for
+        lock types without ``.locked()`` (``_thread.RLock`` grows it
+        only in 3.14).  ``timed`` maps a bounded real-world acquire to
+        the deterministic nothing-else-runnable timeout."""
+        if is_free is None:
+            is_free = lambda: not real.locked()  # noqa: E731
+        while not real.acquire(False):
+            if not blocking:
+                return False
+            if not self.block(is_free, timed=timed, what="lock-wait"):
+                return False
+        return True
+
+    def coop_wait(self, pred, timed: bool = False,
+                  what: str = "") -> bool:
+        return self.block(pred, timed=timed, what=what)
+
+    def _handoff(self, me: _Entry, nxt: _Entry):
+        me.gate.clear()
+        nxt.gate.set()
+        me.gate.wait()
+        if self._abort:
+            raise SchedulerError("schedule aborted")
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _worker(self, entry: _Entry, thunk):
+        self._by_ident[threading.get_ident()] = entry
+        entry.gate.wait()
+        if not self._abort:
+            try:
+                thunk()
+            except BaseException as e:  # noqa: BLE001 - reported, not
+                # swallowed: the failing schedule carries it
+                entry.error = e
+        self._finish(entry)
+
+    def _finish(self, entry: _Entry):
+        entry.finished = True
+        if entry.error is not None and self.result.error is None:
+            self.result.error = entry.error
+            self._abort = True
+        nxt = self._pick_next(entry, can_stay=False)
+        if nxt is None:
+            if any(not e.finished for e in self._entries) and \
+                    self.result.error is None:
+                self.result.error = DeadlockError(
+                    "threads still parked at schedule end: "
+                    + ", ".join(
+                        e.name for e in self._entries if not e.finished
+                    )
+                )
+            self._abort = self._abort or self.result.error is not None
+            self._wake_all()
+            self._done.set()
+            return
+        self.result.trace.append((entry.name, "exit", ""))
+        self.result.decisions.append(nxt.name)
+        nxt.gate.set()
+
+    def _wake_all(self):
+        for e in self._entries:
+            e.gate.set()
+
+    def run(self, thunks, names=None, timeout: float = 60.0
+            ) -> ScheduleResult:
+        """Run ``thunks`` to completion under this schedule."""
+        if not thunks:
+            return self.result
+        names = names or [f"t{i}" for i in range(len(thunks))]
+        if len(set(names)) != len(names):
+            raise ValueError("thread names must be unique")
+        self._entries = [_Entry(n) for n in names]
+        prev_sched = runtime.active_scheduler()
+        if prev_sched is not None:
+            raise SchedulerError("a scheduler is already active")
+        from dlrover_tpu.common import chaos
+
+        runtime._set_scheduler(self)
+        chaos.set_yield_hook(self._chaos_yield)
+        self._running = True
+        try:
+            for entry, thunk in zip(self._entries, thunks):
+                t = runtime.TrackedThread(
+                    target=self._worker, args=(entry, thunk),
+                    name=f"dtsan-{entry.name}", daemon=True,
+                )
+                t._dt_tracked = runtime.active_detector() is not None
+                entry.thread = t
+                t.start()
+            first = self._pick_next(None, can_stay=False)
+            self.result.trace.append(("_driver", "start", ""))
+            self.result.decisions.append(first.name)
+            first.gate.set()
+            if not self._done.wait(timeout):
+                self._abort = True
+                self._wake_all()
+                if self.result.error is None:
+                    self.result.error = SchedulerError(
+                        f"schedule wall-clock timeout after {timeout}s"
+                    )
+            for entry in self._entries:
+                if entry.thread is not None:
+                    entry.thread.join(timeout=5.0)
+        finally:
+            self._running = False
+            runtime._set_scheduler(None)
+            chaos.set_yield_hook(None)
+        det = runtime.active_detector()
+        if det is not None:
+            self.result.races = det.races()
+        return self.result
+
+    def _chaos_yield(self, site: str, ctx: dict):
+        if self.participating():
+            self.yield_point("chaos", site)
+
+
+# -------------------------------------------------------------------------
+# exploration harness
+# -------------------------------------------------------------------------
+
+
+class ExploreResult:
+    def __init__(self):
+        self.schedules: list[ScheduleResult] = []
+        self.failures: list[ScheduleResult] = []
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.failures)
+
+    def describe(self) -> str:
+        head = (
+            f"explored {len(self.schedules)} schedules, "
+            f"{len(self.failures)} failing"
+        )
+        if not self.failures:
+            return head
+        return head + "\n" + self.failures[0].describe()
+
+
+def _derive_seed(seed: int, index: int) -> int:
+    return seed * 7919 + index
+
+
+def run_schedule(
+    make,
+    seed: int,
+    preemption_bound: int | None = None,
+    script: list[str] | None = None,
+    timeout: float = 60.0,
+) -> ScheduleResult:
+    """One schedule: ``make()`` builds fresh state and returns
+    ``(thunks, check)`` — ``check`` (or None) runs after the schedule
+    and raises on a violated invariant (a lost update, a torn read)."""
+    det = runtime.active_detector()
+    if det is not None:
+        det.reset()
+    made = make()
+    thunks, check = made if isinstance(made, tuple) else (made, None)
+    sched = Scheduler(
+        seed=seed, preemption_bound=preemption_bound, script=script
+    )
+    result = sched.run(thunks, timeout=timeout)
+    if result.error is None and check is not None:
+        try:
+            check()
+        except Exception as e:  # noqa: BLE001 - invariant violations
+            # are exactly what the explorer reports
+            result.error = e
+    return result
+
+
+def explore(
+    make,
+    schedules: int = 20,
+    seed: int = 0,
+    preemption_bound: int | None = 2,
+    stop_on_failure: bool = True,
+    timeout: float = 60.0,
+) -> ExploreResult:
+    """Seeded random walk over ``schedules`` interleavings."""
+    out = ExploreResult()
+    for i in range(schedules):
+        result = run_schedule(
+            make, _derive_seed(seed, i),
+            preemption_bound=preemption_bound, timeout=timeout,
+        )
+        out.schedules.append(result)
+        if result.failed:
+            out.failures.append(result)
+            if stop_on_failure:
+                break
+    return out
+
+
+def replay(
+    make,
+    seed: int,
+    preemption_bound: int | None = 2,
+    timeout: float = 60.0,
+) -> ScheduleResult:
+    """Re-run the exact schedule a seed produced (bit-identical trace
+    for a deterministic program)."""
+    return run_schedule(
+        make, seed, preemption_bound=preemption_bound, timeout=timeout
+    )
+
+
+def _failure_signature(result: ScheduleResult) -> tuple:
+    """What kind of failure this is.  An invariant error dominates (the
+    exact race SET varies with the interleaving and must not pin the
+    minimizer); race-only failures compare by their dedup keys."""
+    if result.error is not None:
+        return ("error", type(result.error).__name__)
+    return ("races", frozenset(r.key for r in result.races))
+
+
+def minimize(
+    make,
+    failing: ScheduleResult,
+    timeout: float = 60.0,
+    budget: int = 16,
+) -> ScheduleResult:
+    """Reduce a failing schedule to its essential preemption points:
+    search descending preemption bounds (re-exploring up to ``budget``
+    derived seeds at each) for the SAME failure, and return the failing
+    schedule with the fewest preemptive switches.  A lost update that
+    needs exactly one cross-thread switch minimizes to one."""
+    want = _failure_signature(failing)
+    best = failing
+    for bound in range(len(failing.preemption_points) - 1, -1, -1):
+        found = None
+        for i in range(budget):
+            trial = run_schedule(
+                make, _derive_seed(failing.seed, 1 + bound * budget + i),
+                preemption_bound=bound, timeout=timeout,
+            )
+            if trial.failed and _failure_signature(trial) == want:
+                found = trial
+                break
+        if found is None:
+            break  # the failure needs more preemptions than this bound
+        best = found
+    return best

@@ -38,6 +38,18 @@ from tools.dlint import Baseline, run_checks  # noqa: E402
 DEFAULT_PATHS = ("dlrover_tpu", "tools", "bench.py")
 BASELINE_PATH = os.path.join(_REPO_ROOT, "tools", "dlint", "baseline.json")
 
+# --checker accepts either form: the stable code or the checker name
+CODE_TO_CHECKER = {
+    "DL001": "lock-order",
+    "DL002": "blocking-under-lock",
+    "DL003": "chaos-coverage",
+    "DL004": "signal-safety",
+    "DL005": "jit-purity",
+    "DL006": "message-drift",
+    "DL007": "metric-drift",
+    "DL008": "shared-mut",
+}
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
@@ -51,16 +63,49 @@ def main(argv=None) -> int:
                          "(new entries still need a justification)")
     ap.add_argument("--baseline", default=BASELINE_PATH)
     ap.add_argument("--checker", action="append", default=None,
-                    help="run only the named checker(s)")
+                    help="run only the named checker(s) — by name "
+                         "('shared-mut') or code ('DL008')")
+    ap.add_argument("--lock-inventory", action="store_true",
+                    help="print the lock catalog (keys, reentrancy, "
+                         "ordering edges) from the DL001 model and "
+                         "exit")
     args = ap.parse_args(argv)
 
+    if args.checker is not None:
+        args.checker = [
+            CODE_TO_CHECKER.get(c.upper(), c) for c in args.checker
+        ]
     paths = [
         os.path.join(_REPO_ROOT, p) if not os.path.isabs(p) else p
         for p in (args.paths or DEFAULT_PATHS)
     ]
+
+    if args.lock_inventory:
+        from tools.dlint.core import collect_sources
+        from tools.dlint.locks import lock_inventory
+
+        inv = lock_inventory(collect_sources(paths, _REPO_ROOT))
+        if args.json:
+            print(json.dumps(inv, indent=2))
+        else:
+            print(f"locks ({len(inv['locks'])}):")
+            for key, entry in inv["locks"].items():
+                kind = "rlock/cond" if entry["reentrant"] else "lock"
+                print(f"  {key}  [{kind}]  "
+                      f"{len(entry['sites'])} acquisition site(s)")
+            print(f"\nordering edges ({len(inv['edges'])}), "
+                  f"outer -> inner:")
+            for e in inv["edges"]:
+                print(f"  {e['outer']} -> {e['inner']}  "
+                      f"({e['witness']})")
+        return 0
     t0 = time.monotonic()
-    findings = run_checks(paths, repo_root=_REPO_ROOT,
-                          checkers=args.checker)
+    try:
+        findings = run_checks(paths, repo_root=_REPO_ROOT,
+                              checkers=args.checker)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     elapsed = time.monotonic() - t0
 
     baseline = Baseline.load(args.baseline)

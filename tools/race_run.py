@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Run a named control-plane race scenario under dtsan.
+
+Usage::
+
+    python tools/race_run.py --list
+    python tools/race_run.py kvstore-evict                 # both modes
+    python tools/race_run.py rendezvous-round --mode explore \
+        --schedules 50 --seed 7 --preemption-bound 3
+    python tools/race_run.py metrics-ingest --mode replay --seed 87109
+
+Modes:
+
+- ``detect``  — one real-thread run with the vector-clock detector:
+  catches what actually raced under this interleaving.
+- ``explore`` — a seeded random walk over ``--schedules``
+  deterministic interleavings (preemption-bounded): catches what COULD
+  race, and prints the failing seed. Failures are then minimized to
+  their essential preemption points.
+- ``replay``  — re-run the exact schedule of ``--seed`` (a failing
+  seed printed by explore): bit-identical trace, same failure.
+- ``both``    — detect then explore (the default).
+
+Exit status: 0 clean, 1 races/failures found, 2 usage error — the same
+contract as tools/lint.py, so CI treats a race like a lint finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools import dtsan  # noqa: E402
+from tools.dtsan.scenarios import SCENARIOS  # noqa: E402
+
+
+def _list() -> int:
+    width = max(len(n) for n in SCENARIOS)
+    print("available race scenarios:\n")
+    for name in sorted(SCENARIOS):
+        print(f"  {name:<{width}}  {SCENARIOS[name].desc}")
+    print(
+        "\nrun one:  python tools/race_run.py <name> "
+        "[--mode detect|explore|replay|both]"
+    )
+    return 0
+
+
+def _detect(sc) -> int:
+    races, err = sc.run_detect()
+    for race in races:
+        print(race.format())
+    if err is not None:
+        print(f"invariant check failed: {err!r}")
+    status = "FAIL" if (races or err) else "ok"
+    print(f"detect[{sc.name}]: {status} ({len(races)} races)")
+    return 1 if (races or err) else 0
+
+
+def _explore(sc, args) -> int:
+    result = dtsan.explore(
+        sc.make,
+        schedules=args.schedules,
+        seed=args.seed,
+        preemption_bound=args.preemption_bound,
+        stop_on_failure=True,
+    )
+    print(f"explore[{sc.name}]: {result.describe()}")
+    if not result.failed:
+        return 0
+    failing = result.failures[0]
+    reduced = dtsan.minimize(sc.make, failing)
+    # replay must use the bound the reduced schedule RAN with (not its
+    # preemption count): the forced-stay branch changes RNG consumption
+    bound = reduced.preemption_bound
+    print(
+        f"minimized: {len(failing.preemption_points)} -> "
+        f"{len(reduced.preemption_points)} preemptions "
+        f"(replay with --mode replay --seed {reduced.seed}"
+        + (f" --preemption-bound {bound}" if bound is not None else "")
+        + ")"
+    )
+    return 1
+
+
+def _replay(sc, args) -> int:
+    result = dtsan.replay(
+        sc.make, args.seed, preemption_bound=args.preemption_bound
+    )
+    print(f"replay[{sc.name}]: {result.describe()}")
+    if args.trace:
+        for i, (thread, kind, detail) in enumerate(result.trace):
+            print(f"  {i:4d}  {thread:<12} {kind:<12} {detail}")
+    return 1 if result.failed else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dtsan race scenarios over the real subsystems"
+    )
+    ap.add_argument("scenario", nargs="?", default=None)
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    ap.add_argument("--mode", default="both",
+                    choices=("detect", "explore", "replay", "both"))
+    ap.add_argument("--schedules", type=int, default=50,
+                    help="explorer: interleavings to sweep (default 50)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="explore: base seed / replay: failing seed")
+    ap.add_argument("--preemption-bound", type=int, default=2,
+                    help="max preemptive switches per schedule "
+                         "(default 2; CHESS-style small bounds find "
+                         "most races fastest)")
+    ap.add_argument("--trace", action="store_true",
+                    help="replay: dump the full interleaving trace")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        return _list()
+    if args.scenario is None:
+        ap.print_usage()
+        print("error: name a scenario or pass --list", file=sys.stderr)
+        return 2
+    sc = SCENARIOS.get(args.scenario)
+    if sc is None:
+        print(
+            f"error: unknown scenario {args.scenario!r} "
+            f"(have: {', '.join(sorted(SCENARIOS))})", file=sys.stderr,
+        )
+        return 2
+
+    dtsan.enable()
+    try:
+        if args.mode == "detect":
+            return _detect(sc)
+        if args.mode == "explore":
+            return _explore(sc, args)
+        if args.mode == "replay":
+            return _replay(sc, args)
+        rc = _detect(sc)
+        return max(rc, _explore(sc, args))
+    finally:
+        dtsan.disable()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
