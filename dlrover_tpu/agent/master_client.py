@@ -228,6 +228,21 @@ class MasterClient:
             msg.DrainNodeRequest(node_rank=node_rank)
         )
 
+    def report_preempt_notice(
+        self, node_rank: int, deadline: float, lead_s: float = 0.0,
+    ) -> msg.PreemptNoticeDirective:
+        """Relay an announced preemption (this host dies at
+        ``deadline``) and fetch the brain's directive. Fail-fast: the
+        lead window is short, and an unreachable master just means the
+        unannounced-kill fallback path — never a stall."""
+        res = self._get(
+            msg.PreemptNoticeRequest(
+                node_rank=node_rank, deadline=deadline, lead_s=lead_s,
+            ),
+            retries=2,
+        )
+        return res if res is not None else msg.PreemptNoticeDirective()
+
     def get_comm_world(self, rdzv_name: str, node_rank: int):
         world: msg.CommWorld = self._get(
             msg.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)
@@ -387,8 +402,12 @@ class MasterClient:
     def report_elastic_run_config(self, configs: dict) -> bool:
         return self._report(msg.ElasticRunConfig(configs=configs))
 
-    def get_elastic_run_config(self) -> dict:
-        res: msg.ElasticRunConfig = self._get(msg.ElasticRunConfigRequest())
+    def get_elastic_run_config(self, retries: int | None = None) -> dict:
+        # explicit retries = fail-fast advisory polls (the trainer's
+        # cadence adoption must never stall a step boundary)
+        res: msg.ElasticRunConfig = self._get(
+            msg.ElasticRunConfigRequest(), retries
+        )
         return res.configs if res else {}
 
     # ------------------------------------------------------------ kv store

@@ -279,17 +279,31 @@ _DEVICE_ERROR_PATTERNS = (
 
 
 def classify_exit(
-    returncode: int, log_tail: str = "", stopping: bool = False
+    returncode: int, log_tail: str = "", stopping: bool = False,
+    draining: bool = False,
 ) -> str:
     if returncode == 0:
         return "succeeded"
-    if stopping and (
+    if (stopping or draining) and (
         -returncode == signal.SIGTERM or returncode == ExitCode.TERMED
     ):
         # the AGENT sent that SIGTERM (stop/restart path): a worker
         # dying of it is a clean stop, not a software failure — it must
-        # not burn a restart budget or be reported as a fault
+        # not burn a restart budget or be reported as a fault. The same
+        # holds for a SIGTERM landing during an announced-preemption
+        # drain: the teardown is the PLAN, not a failure — without the
+        # draining flag this exact notice-then-SIGTERM shape was
+        # charged as a software failure (and the ledger billed the
+        # whole event to restart even when the drain succeeded).
         return "stopped"
+    if draining and (
+        -returncode in (signal.SIGKILL, signal.SIGTERM)
+        or returncode in (ExitCode.KILLED, ExitCode.TERMED)
+    ):
+        # the platform's announced kill landed while (or after) the
+        # drain ran: account it as the preemption it is — no restart
+        # budget burned, no software-failure report
+        return "preempted"
     if returncode in ExitCode.HARDWARE_ERRORS or -returncode in (
         signal.SIGABRT,
         signal.SIGBUS,
@@ -336,6 +350,11 @@ class ElasticTrainingAgent:
         # set while the agent itself is terminating workers, so their
         # -SIGTERM exits classify as "stopped" instead of "software"
         self._stopping = False
+        # set once an announced-preemption drain ran (the run loop
+        # returns right after, so this is observable state for tests
+        # and the exit taxonomy, not a loop flag)
+        self._draining = False
+        self._start_mono = time.monotonic()
         # True while the current contiguous hang-diagnosis episode has
         # already been flight-dumped (one artifact per episode, not one
         # per monitor tick); cleared when the verdict clears
@@ -658,6 +677,11 @@ class ElasticTrainingAgent:
             if failed:
                 idx, code = failed[0]
                 tail = self._log_tail(idx)
+                # NOTE draining never reaches this classify: the drain
+                # path stops its workers synchronously and returns from
+                # the loop in the same iteration. classify_exit's
+                # draining arms serve platform integrations that
+                # observe worker deaths after a notice out-of-band.
                 kind = classify_exit(code, tail, stopping=self._stopping)
                 if kind == "stopped":
                     continue  # our own SIGTERM; the stop path finishes it
@@ -706,6 +730,17 @@ class ElasticTrainingAgent:
             # triggers a local flight-recorder dump (the worker's own
             # detector may be the thing that's stuck)
             self._poll_diagnosis()
+            # announced preemption: the platform (simulated by the
+            # ``preempt.notice`` chaos action) says this host dies at a
+            # deadline — relay to the brain and, when directed, drain
+            # (checkpoint + drained departure + clean worker stop) so
+            # the whole event lands in the reshape bucket. An
+            # unconsumed/unannounced kill keeps the restart path.
+            if self._poll_preempt_notice():
+                logger.info(
+                    "predictive drain complete; awaiting preemption"
+                )
+                return 0
             # check membership changes: a waiting node, or a round the
             # master already re-formed from carried-over survivors
             # (reshape-first elasticity forms rounds without survivors
@@ -742,6 +777,84 @@ class ElasticTrainingAgent:
             rank=self._config.node_rank, **info,
         )
         flight.dump("hang-diagnosis", diagnosis=info)
+
+    # --------------------------------------------- announced preemptions
+
+    def _poll_preempt_notice(self) -> bool:
+        """Consume a pending preemption notice, relay it to the
+        master's brain, and execute the directed predictive drain.
+        Returns True when the drain ran (the agent should shut down
+        gracefully and wait for the kill). Master unreachable or
+        directive \"none\" leaves the unannounced-kill fallback path
+        untouched."""
+        from dlrover_tpu.common import chaos
+
+        chaos_point(
+            "preempt.notice", rank=self._config.node_rank,
+            elapsed=time.monotonic() - self._start_mono,
+        )
+        notice = chaos.take_preempt_notice()
+        if notice is None:
+            return False
+        deadline = float(notice.get("deadline", 0.0))
+        lead = max(deadline - time.time(), 0.0)
+        telemetry.event(
+            "preempt.notice", rank=self._config.node_rank,
+            lead=round(lead, 3), deadline=deadline,
+        )
+        logger.warning(
+            "preemption notice: this host dies in %.2fs; asking the "
+            "brain", lead,
+        )
+        directive = None
+        try:
+            directive = self._client.report_preempt_notice(
+                self._config.node_rank, deadline, lead
+            )
+        except (ConnectionError, OSError):
+            # master unreachable inside the lead window: the
+            # unannounced-kill path (restart + checkpoint replay) is
+            # the unchanged fallback
+            logger.warning(
+                "could not relay the preemption notice (master "
+                "unreachable); the kill will land unannounced"
+            )
+        except Exception:  # noqa: BLE001 - advisory path
+            logger.warning("preempt notice relay failed", exc_info=True)
+        if directive is None or getattr(directive, "action", "") != "drain":
+            return False
+        self._execute_predrain(
+            deadline, getattr(directive, "plan_id", "")
+        )
+        return True
+
+    def _execute_predrain(self, deadline: float, plan_id: str):
+        """The doomed host's half of a predictive-drain plan, ordered
+        for maximal overlap with the survivors' reshape: (1) the drain
+        report — survivors start reshaping around this host
+        immediately; (2) flush the shm checkpoint to storage so the
+        replacement resumes with zero replay; (3) stop workers cleanly
+        before the platform kill lands. The ``elastic.drained`` marker
+        is what re-charges the teardown gap from ``restart`` to
+        ``reshape`` in the goodput ledger."""
+        t0 = time.monotonic()
+        self._draining = True
+        try:
+            self._client.drain_node(self._config.node_rank)
+        except (ConnectionError, OSError):
+            logger.warning(
+                "drain report failed; survivors will see a dead "
+                "departure instead"
+            )
+        self._save_ckpt_at_breakpoint()
+        budget = max(deadline - time.time() - 1.0, 1.0)
+        self._stop_workers(timeout=min(budget, 30.0))
+        telemetry.event(
+            "elastic.drained", rank=self._config.node_rank,
+            plan=plan_id, dur=time.monotonic() - t0,
+            deadline=deadline,
+        )
+        telemetry.flush()
 
     def _membership_changed(self) -> bool:
         try:

@@ -8,10 +8,17 @@ This module is the one place every fault comes from: named **fault
 sites** threaded through the control plane (``rpc.send``, ``rpc.recv``,
 ``ipc.request``, ``agent.spawn``, ``ckpt.write``, ``ckpt.manifest``,
 ``ckpt.save``, ``rdzv.join``, ``master.kill``, ``elastic.signal``,
-``elastic.reshape``) consult a seeded schedule
+``elastic.reshape``, ``preempt.notice``, ``brain.plan``) consult a
+seeded schedule
 that can drop or
 delay RPC frames, kill or hang a process at a chosen step, tear a
-checkpoint payload mid-shard, or bit-flip persisted bytes.
+checkpoint payload mid-shard, bit-flip persisted bytes — or announce a
+preemption: the ``notice`` action (simulated TPU maintenance/spot
+signal) records a pending-preemption notice with a seeded lead time
+and arms a timer that kills the process at the deadline whether or not
+anyone listened. Consumers (the training agent's monitor loop) poll
+:func:`take_preempt_notice` and get the lead window to checkpoint and
+drain; an unconsumed notice is just an unannounced kill.
 
 Determinism contract: a schedule carries one ``seed``; every rule draws
 from its own ``random.Random`` derived from (seed, rule index), so the
@@ -35,12 +42,18 @@ themselves at import.
 Rule fields (all optional except ``site`` and ``action``)::
 
     site:   fault-site name, e.g. "rpc.send"
-    action: drop | disconnect | delay | hang | kill | error
+    action: drop | disconnect | delay | hang | kill | error | notice
             | tear | bitflip           (tear/bitflip: transform sites)
     prob:   fire probability per matching call (default 1.0, seeded)
     step:   only fire when the site reports this training step
     verb:   only fire for this RPC verb ("get"/"report")
     msg:    only fire for these message type names (str or list)
+    rank:   only fire when the site reports this node rank (preempt
+            notices target one host of a multi-host schedule)
+    at:     only fire once the site reports ``elapsed`` >= this many
+            seconds (sites that pass elapsed time, e.g. the agent's
+            preempt.notice poll) — time-anchored events stay aligned
+            across comparison arms whose step rates differ
     after:  skip the first N matching calls
     every:  fire on the first eligible call and every k-th thereafter
             (eligible calls 1, 1+k, 1+2k, ...; default 1 = all)
@@ -48,6 +61,11 @@ Rule fields (all optional except ``site`` and ``action``)::
     delay:  seconds for delay/hang (default 0.2 / 3600)
     frac:   fraction of payload kept by tear (default 0.5)
     exit_code: status for kill (default 137)
+    lead:   notice lead time in seconds — a number, or [lo, hi] for a
+            seeded-deterministic draw from the rule's own RNG
+            (default 10.0)
+    enforce: notice only — False records the notice without arming the
+            deadline kill timer (in-process policy tests; default True)
 """
 
 from __future__ import annotations
@@ -83,6 +101,7 @@ class ChaosRule:
 
     _CONTROL_ACTIONS = (
         "drop", "disconnect", "delay", "hang", "kill", "error",
+        "notice",
     )
     _TRANSFORM_ACTIONS = ("tear", "bitflip")
 
@@ -110,6 +129,12 @@ class ChaosRule:
         )
         self.frac = float(spec.get("frac", 0.5))
         self.exit_code = int(spec.get("exit_code", _KILL_EXIT_CODE))
+        self.rank = spec.get("rank")
+        self.at = spec.get("at")
+        # notice lead: a number, or [lo, hi] drawn from the rule RNG at
+        # fire time (seeded-deterministic like every other draw here)
+        self.lead = spec.get("lead", 10.0)
+        self.enforce = bool(spec.get("enforce", True))
         # rule-local RNG: interleaving with OTHER rules can't perturb
         # this rule's draw sequence
         self._rng = random.Random(seed * 1000003 + index)
@@ -123,7 +148,22 @@ class ChaosRule:
             return False
         if self.msg is not None and ctx.get("msg") not in self.msg:
             return False
+        if self.rank is not None and ctx.get("rank") != self.rank:
+            return False
+        if self.at is not None and float(
+            ctx.get("elapsed", 0.0) or 0.0
+        ) < float(self.at):
+            return False
         return True
+
+    def draw_lead(self) -> float:
+        """Notice lead time for THIS fire: fixed, or a seeded draw
+        from [lo, hi] — rule-local RNG, so the lead pattern replays
+        exactly with the schedule."""
+        if isinstance(self.lead, (list, tuple)):
+            lo, hi = float(self.lead[0]), float(self.lead[1])
+            return lo + (hi - lo) * self._rng.random()
+        return float(self.lead)
 
     def should_fire(self, ctx: dict) -> bool:
         """Call-counting + probability draw; caller holds registry lock."""
@@ -216,6 +256,11 @@ class ChaosRegistry:
             maxlen=self.MAX_FIRED_LOG
         )
         self._counts: dict[str, int] = {}
+        # announced preemptions: notices recorded by the "notice"
+        # action, consumed (once each) via take_preempt_notice; the
+        # deadline kill timers so uninstall() can disarm them
+        self._notices: list[dict] = []
+        self._timers: list[threading.Timer] = []
 
     def _select(self, site: str, ctx: dict) -> list[ChaosRule]:
         with self._lock:
@@ -248,7 +293,10 @@ class ChaosRegistry:
         # apply OUTSIDE the lock: delay/hang must not serialize other
         # sites, and kill would orphan the lock
         for rule in self._select(site, ctx):
-            rule.apply(site, ctx)
+            if rule.action == "notice":
+                self._schedule_preemption(rule, site, ctx)
+            else:
+                rule.apply(site, ctx)
 
     def transform(self, site: str, data, ctx: dict):
         for rule in self._select(site, ctx):
@@ -258,6 +306,96 @@ class ChaosRegistry:
     def summary(self) -> dict:
         with self._lock:
             return dict(self._counts)
+
+    # ------------------------------------------- announced preemptions
+
+    def _schedule_preemption(self, rule: ChaosRule, site: str, ctx: dict):
+        """The ``notice`` action: record a pending-preemption notice
+        with a seeded lead, and (unless ``enforce: false``) arm a
+        timer that kills this process at the deadline — the kill lands
+        whether or not anyone consumed the notice, exactly like a real
+        maintenance/spot preemption."""
+        lead = rule.draw_lead()
+        notice = {
+            "site": site,
+            "deadline": time.time() + lead,
+            "lead": lead,
+            "exit_code": rule.exit_code,
+            "ctx": dict(ctx),
+            "taken": False,
+        }
+        with self._lock:
+            self._notices.append(notice)
+        logger.warning(
+            "chaos[notice] at %s: preemption announced, kill in %.2fs "
+            "(enforce=%s, ctx=%s)", site, lead, rule.enforce, ctx,
+        )
+        telemetry.event(
+            "chaos.preempt.notice", site=site, lead=round(lead, 3),
+            rank=ctx.get("rank"), enforced=rule.enforce,
+        )
+        if rule.enforce:
+            timer = threading.Timer(
+                lead, self._preempt_kill, args=(notice,)
+            )
+            timer.daemon = True
+            with self._lock:
+                self._timers.append(timer)
+            timer.start()
+
+    def _preempt_kill(self, notice: dict):
+        logger.warning(
+            "chaos[notice] deadline reached: exiting %d",
+            notice["exit_code"],
+        )
+        try:
+            # same crash-path contract as the kill action: dump the
+            # flight record and persist the telemetry snapshot NOW —
+            # the deadline kill (and everything before it) must survive
+            # into the merged timeline either way
+            from dlrover_tpu.common import flight
+
+            telemetry.event(
+                "chaos.fire", site=notice["site"], action="kill",
+                announced=True,
+            )
+            flight.dump(
+                "chaos-preempt", site=notice["site"],
+                deadline=notice["deadline"],
+            )
+            telemetry.flush()
+        except Exception:  # noqa: BLE001 - dying anyway
+            pass
+        os._exit(notice["exit_code"])
+
+    def take_preempt_notice(self) -> dict | None:
+        """Consume the oldest unconsumed preemption notice (None when
+        none stands). Consuming does NOT disarm the deadline kill —
+        the host still dies on schedule; the notice only buys the lead
+        window to checkpoint and drain."""
+        with self._lock:
+            for n in self._notices:
+                if not n["taken"]:
+                    n["taken"] = True
+                    return dict(n)
+        return None
+
+    def pending_preempt_deadline(self) -> float | None:
+        """Earliest unexpired announced-kill deadline, or None."""
+        now = time.time()
+        with self._lock:
+            pending = [
+                n["deadline"] for n in self._notices
+                if n["deadline"] > now
+            ]
+        return min(pending) if pending else None
+
+    def cancel_preemptions(self):
+        """Disarm every pending deadline kill (uninstall/tests)."""
+        with self._lock:
+            timers, self._timers = self._timers, []
+        for t in timers:
+            t.cancel()
 
 
 # -------------------------------------------------------------------------
@@ -312,6 +450,11 @@ def install(schedule: dict | str) -> ChaosRegistry:
     """Arm a schedule in this process (tests/tools). ``schedule`` may be
     a dict, inline JSON, ``@path``, or a :data:`NAMED_SCHEDULES` key."""
     global _REGISTRY
+    if _REGISTRY is not None:
+        # replacing a schedule must not leave the OLD registry's armed
+        # deadline kills behind — an orphaned notice timer would take
+        # the process down mid-way through the next schedule
+        _REGISTRY.cancel_preemptions()
     _REGISTRY = ChaosRegistry(resolve_schedule(schedule))
     logger.warning(
         "chaos armed: seed=%d rules=%d",
@@ -322,7 +465,29 @@ def install(schedule: dict | str) -> ChaosRegistry:
 
 def uninstall():
     global _REGISTRY
+    if _REGISTRY is not None:
+        # an in-process test uninstalling a schedule must not leave an
+        # armed deadline kill behind to take the test runner down later
+        _REGISTRY.cancel_preemptions()
     _REGISTRY = None
+
+
+def take_preempt_notice() -> dict | None:
+    """Consume the oldest unconsumed announced-preemption notice in
+    this process (None when disarmed or none stands)."""
+    reg = _REGISTRY
+    if reg is None:
+        return None
+    return reg.take_preempt_notice()
+
+
+def pending_preempt_deadline() -> float | None:
+    """Earliest unexpired announced-kill deadline (None when disarmed
+    or nothing is pending)."""
+    reg = _REGISTRY
+    if reg is None:
+        return None
+    return reg.pending_preempt_deadline()
 
 
 def resolve_schedule(spec: dict | str) -> dict:
@@ -426,6 +591,45 @@ NAMED_SCHEDULES: dict[str, dict] = {
                 "action": "kill",
                 "verb": "reshard",
                 "after": 2,
+                "max": 1,
+            },
+        ],
+    },
+    # a compressed "week" of production faults against the repair
+    # brain: an ANNOUNCED preemption (host rank 1 gets a notice with a
+    # seeded 2-3 s lead — brain-on pre-drains it into the reshape
+    # bucket, brain-off eats the unannounced-kill fallback) and a hard
+    # unannounced kill (host rank 0, the restart path). The persistent
+    # straggler (brain evicts it) and the scale-out joiner are driven
+    # by the harness (tools/chaos_run.py ``_run_week``), which runs
+    # the same seed brain-on vs brain-off and publishes
+    # goodput_brain_on_pct / goodput_brain_off_pct /
+    # preempt_notice_saved_s.
+    "week-in-the-life": {
+        "desc": "mixed week: announced preemption (brain pre-drains "
+        "into the reshape bucket), a hard kill, an injected persistent "
+        "straggler the brain evicts, and a scale-out — run brain-on vs "
+        "brain-off on one seed, publishing goodput_brain_on/off_pct "
+        "and preempt_notice_saved_s",
+        "seed": 31,
+        "rules": [
+            # time-anchored (``at`` = seconds of host uptime), NOT
+            # call-counted: the brain's own actions change the step
+            # rate, and the on/off arms must experience the same
+            # faults at the same times to be comparable
+            {
+                "site": "preempt.notice",
+                "action": "notice",
+                "rank": 1,
+                "at": 4.0,
+                "max": 1,
+                "lead": [2.0, 3.0],
+            },
+            {
+                "site": "preempt.notice",
+                "action": "kill",
+                "rank": 0,
+                "at": 14.0,
                 "max": 1,
             },
         ],

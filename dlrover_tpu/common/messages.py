@@ -178,6 +178,33 @@ class DrainNodeRequest(Message):
 
 
 @dataclass
+class PreemptNoticeRequest(Message):
+    """A doomed host relays its announced preemption (maintenance /
+    spot notice, simulated by the ``preempt.notice`` chaos action):
+    the platform will kill it at ``deadline``. The master's repair
+    brain answers with a directive — ``drain`` means: checkpoint,
+    report the drain, stop workers cleanly, and let survivors reshape
+    around you before the kill lands."""
+
+    node_rank: int = 0
+    deadline: float = 0.0
+    lead_s: float = 0.0
+
+
+@dataclass
+class PreemptNoticeDirective(Message):
+    """The brain's answer to a preemption notice. ``action`` is
+    ``"drain"`` (execute the predictive drain) or ``"none"`` (brain
+    disabled / no plan — the unannounced-kill fallback path stands).
+    ``plan_id`` is stable across re-sends of the same notice, so a
+    master failover mid-plan re-serves the identical plan."""
+
+    action: str = "none"
+    plan_id: str = ""
+    deadline: float = 0.0
+
+
+@dataclass
 class WaitingNodeNumRequest(Message):
     node_id: int = 0
     local_world_size: int = 1

@@ -490,6 +490,12 @@ EVENT_CATEGORY = {
     # ledger can price a scale event at seconds instead of burying it
     # in ``restart``
     "elastic.reshape": "reshape",
+    # the doomed host's half of an announced-preemption drain
+    # (checkpoint flush + drained departure + clean worker stop): part
+    # of the planned scale event, priced with it — and the marker the
+    # incarnation-gap sweep below uses to re-charge the teardown gap
+    # from ``restart`` to ``reshape``
+    "elastic.drained": "reshape",
     # the agent's master-outage ride-through: emitted with the outage
     # duration once the (restarted) master answers again. Charged to
     # ``restart`` — anything workers productively overlapped still wins
@@ -508,6 +514,13 @@ _PRIORITY = (
     "productive", "compile", "reshape", "checkpoint", "rendezvous",
     "restart",
 )
+
+# a drained-departure marker claims an incarnation gap when it falls
+# inside the gap or this many seconds before it (the agent emits the
+# marker after stopping its workers, so the worker's last event can
+# slightly precede it — and the checkpoint-flush leg of the drain runs
+# before the marker lands)
+_DRAIN_GAP_SLACK_S = 30.0
 
 
 def _interval_events(snap: dict):
@@ -538,6 +551,7 @@ def goodput_ledger(snapshots, now: float | None = None) -> dict:
     intervals: list[tuple[float, float, str]] = []
     tmin = tmax = None
     worker_ranges = []
+    drained_marks: list[float] = []
     for snap in snapshots:
         events = snap.get("events") or []
         times = [float(e["t"]) for e in events]
@@ -547,6 +561,13 @@ def goodput_ledger(snapshots, now: float | None = None) -> dict:
             tmax = hi if tmax is None else max(tmax, hi)
             if snap.get("role") == "worker":
                 worker_ranges.append((lo, hi))
+        for ev in events:
+            # agent/host-emitted drained markers: an announced
+            # preemption whose predictive drain SUCCEEDED (checkpoint
+            # flushed, departure reported) — the teardown gap it
+            # brackets is a planned scale event, not a restart
+            if ev.get("kind") == "elastic.drained":
+                drained_marks.append(float(ev["t"]))
         for iv in _interval_events(snap):
             intervals.append(iv)
             tmin = iv[0] if tmin is None else min(tmin, iv[0])
@@ -559,13 +580,32 @@ def goodput_ledger(snapshots, now: float | None = None) -> dict:
     end = max(tmax, now) if now is not None else tmax
     # dead-worker gaps: between one worker incarnation's last activity
     # and the next incarnation's first — restart time, unless something
-    # more specific (rendezvous) claims part of it
+    # more specific (rendezvous) claims part of it. EXCEPT a gap a
+    # drained-departure marker brackets: a notice-then-teardown whose
+    # predictive drain succeeded used to be charged to ``restart`` all
+    # the same, which made announced preemptions look exactly as
+    # expensive as unannounced ones — that gap is the planned scale
+    # event and accounts as ``reshape``. A marker must sit near the
+    # GAP'S START (within the slack window either side) and each
+    # marker claims at most one gap, so one drain cannot whitewash a
+    # later unrelated restart. (Collapsed-timeline caveat: like the
+    # rest of this utilization view, a drained marker from a
+    # CONCURRENT node's event can claim an unrelated gap; per-node
+    # ledgers disambiguate.)
     worker_ranges.sort()
+    drained_marks.sort()
     for (prev_lo, prev_hi), (next_lo, _next_hi) in zip(
         worker_ranges, worker_ranges[1:]
     ):
         if next_lo > prev_hi:
-            intervals.append((prev_hi, next_lo, "restart"))
+            cat = "restart"
+            hi_bound = min(next_lo, prev_hi + _DRAIN_GAP_SLACK_S)
+            for i, d in enumerate(drained_marks):
+                if prev_hi - _DRAIN_GAP_SLACK_S <= d <= hi_bound:
+                    cat = "reshape"
+                    del drained_marks[i]  # one claim per marker
+                    break
+            intervals.append((prev_hi, next_lo, cat))
 
     totals = _sweep(intervals, tmin, end)
     total = end - tmin

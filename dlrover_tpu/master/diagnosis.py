@@ -114,6 +114,7 @@ class DiagnosisManager:
         hang_floor_s: float = HANG_FLOOR_S,
         check_interval: float = CHECK_INTERVAL,
         slo_watchdog=None,
+        brain=None,
     ):
         self._telemetry = job_telemetry
         self._speed_monitor = speed_monitor
@@ -121,6 +122,10 @@ class DiagnosisManager:
         # this manager's rate-limited sweep: breaches are a diagnosis
         # verdict like stragglers/hangs, not a separate scanner thread
         self.slo = slo_watchdog
+        # the repair brain (master/brain.py) rides the same sweep:
+        # fresh verdicts feed its policies AFTER the manager's lock is
+        # released (its actuators call into other components)
+        self.brain = brain
         self._ratio = ratio
         self._zscore = zscore
         self._hang_factor = hang_factor
@@ -383,11 +388,23 @@ class DiagnosisManager:
                 )
             self._stragglers = stragglers
             self._hangs = hangs
-            return {
+            result = {
                 "stragglers": dict(stragglers),
                 "hangs": dict(hangs),
                 "slo": slo,
             }
+        # the brain runs OUTSIDE the manager lock: its policies call
+        # into other components (rendezvous drain, run configs, WAL),
+        # and only fresh (non-cached) sweeps feed it — the rate limit
+        # above is also the brain's
+        brain = self.brain
+        if brain is not None:
+            try:
+                brain.sweep(result, now)
+            except Exception:  # noqa: BLE001 - a policy bug must not
+                # take straggler/hang detection down with it
+                logger.exception("brain sweep failed")
+        return result
 
     def stragglers(self) -> dict[int, dict]:
         return self.check()["stragglers"]

@@ -148,6 +148,21 @@ def render_prometheus(servicer) -> str:
                 {"key": key, "rule": info.get("rule", "")}, 1,
                 "standing SLO breaches (1 per active breach)", "gauge",
             )
+    brain = getattr(servicer, "brain", None)
+    if brain is not None:
+        s = brain.summary()
+        for state, n in sorted(s.get("states", {}).items()):
+            sample(
+                "dlrtpu_brain_plans", {"state": state}, n,
+                "repair-brain ScalePlans by state", "gauge",
+            )
+        if s.get("cadence_save_steps"):
+            sample(
+                "dlrtpu_brain_cadence_save_steps", {},
+                s["cadence_save_steps"],
+                "brain-published checkpoint cadence (save_steps)",
+                "gauge",
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -181,6 +196,8 @@ class MasterHttpPlane:
             "hangs": verdicts.get("hangs", {}),
         }
         report["slo"] = verdicts.get("slo", {})
+        brain = getattr(self._servicer, "brain", None)
+        report["brain"] = brain.summary() if brain is not None else {}
         return report
 
     def series_payload(self, query: dict) -> dict:
@@ -310,7 +327,8 @@ DASHBOARD_HTML = """<!doctype html>
 <div id="steps"></div>
 <h2>MFU (train.mfu, per source)</h2><div id="mfu"></div>
 <h2>SLO breaches</h2><div id="slo" class="ok">none</div>
-<h2>recent events (reshape / restart / ckpt / slo / diagnosis)</h2>
+<h2>brain (repair plans)</h2><pre id="brain">none</pre>
+<h2>recent events (reshape / restart / ckpt / slo / diagnosis / brain)</h2>
 <pre id="events"></pre>
 <script>
 const CAT_COLORS = {productive:'#4a4', compile:'#48c', reshape:'#a6d',
@@ -370,7 +388,25 @@ async function tick() {
       slo.textContent = breaches.map(
         ([k, v]) => k + ' ' + JSON.stringify(v)).join('\\n');
     } else { slo.className = 'ok'; slo.textContent = 'none'; }
-    const interesting = /^(elastic\\.|master\\.|ckpt\\.restore|rdzv\\.|slo\\.|diagnosis\\.)/;
+    const brain = rep.brain || {};
+    const plans = brain.recent || [];
+    const bEl = document.getElementById('brain');
+    if (plans.length) {
+      bEl.textContent =
+        'enabled=' + brain.enabled +
+        (brain.cadence_save_steps ?
+          '  cadence save_steps=' + brain.cadence_save_steps : '') +
+        '\\n' + plans.map(p =>
+          p.plan_id + '  ' + p.kind +
+          (p.target >= 0 ? ' rank=' + p.target : '') +
+          '  [' + p.state + ']  ' +
+          new Date(p.updated * 1000).toISOString().slice(11, 19)
+        ).join('\\n');
+    } else {
+      bEl.textContent = 'enabled=' + (brain.enabled !== false) +
+        '  (no plans yet)';
+    }
+    const interesting = /^(elastic\\.|master\\.|ckpt\\.restore|rdzv\\.|slo\\.|diagnosis\\.|brain\\.|preempt\\.)/;
     const evs = (rep.timeline || []).filter(
       e => interesting.test(e.kind)).slice(-25);
     document.getElementById('events').textContent = evs.map(e =>

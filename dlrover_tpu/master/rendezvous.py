@@ -302,6 +302,21 @@ class RendezvousManager:
         with self._lock:
             return self._rdzv_round
 
+    def latest_members(self) -> tuple[int, list[int]]:
+        """(round, member ranks) of the latest FORMED round — the
+        repair brain's picture of who is in the job when it prices an
+        eviction or checks a drain plan's completion. The formed set
+        wins; between dissolution and re-formation the last formed
+        membership stands (the brain must not read a transient empty
+        world as 'everyone left')."""
+        with self._lock:
+            members = (
+                sorted(self._rdzv_nodes)
+                if self._rdzv_nodes
+                else list(self._latest_rdzv_nodes)
+            )
+            return self._rdzv_round, members
+
     def consensus_restore_step(self) -> int:
         """The NEWEST checkpoint step restorable on every member of the
         latest formed round (-1 = no forcing). Hosts restore exactly

@@ -159,17 +159,34 @@ class MasterServicer(RpcService):
         )
 
         self.metrics_store = MetricsStore()
+        # the elastic repair brain: straggler verdicts, SLO breaches
+        # and preemption notices become durable reshape-first
+        # ScalePlans executed through drain_node + the run-config
+        # channel. Its plan WAL/snapshot hooks resolve the state store
+        # lazily (set after construction by the owning JobMaster).
+        from dlrover_tpu.master.brain import RepairBrain
+
+        self.brain = RepairBrain(
+            servicer=self,
+            rdzv_manager=self.rdzv_managers.get(
+                RendezvousName.ELASTIC_TRAINING
+            ),
+            wal_fn=lambda op, **fields: self._wal(op, **fields),
+            dirty_fn=self._mark_dirty,
+        )
         # runtime straggler/hang diagnosis over the merged telemetry
         # (per-host TimerRing phase gauges + step.end activity); checks
         # are pull-driven from heartbeats and diagnosis queries. The
         # SLO watchdog rides the same rate-limited sweep so breaches
-        # surface next to straggler/hang verdicts.
+        # surface next to straggler/hang verdicts — and the brain
+        # rides it too, turning fresh verdicts into ScalePlans.
         from dlrover_tpu.master.diagnosis import DiagnosisManager
 
         self.diagnosis = DiagnosisManager(
             self.telemetry,
             speed_monitor=getattr(task_manager, "speed_monitor", None),
             slo_watchdog=SloWatchdog(self.metrics_store, self.telemetry),
+            brain=self.brain,
         )
         # durable control-plane state (master failover); set by the
         # owning JobMaster when a state dir is configured
@@ -265,6 +282,16 @@ class MasterServicer(RpcService):
                 normal=done or bool(diagnosed), nodes=nodes,
                 reason=blame,
             )
+        if isinstance(message, msg.PreemptNoticeRequest):
+            # the doomed host's lead window is ticking: decide (or
+            # re-serve — idempotent key, exactly once across a master
+            # failover) the predictive-drain plan and answer with the
+            # directive the agent executes locally
+            directive = self.brain.handle_preempt_notice(
+                message.node_rank, message.deadline, message.lead_s
+            )
+            self._mark_dirty()
+            return msg.PreemptNoticeDirective(**directive)
         if isinstance(message, msg.DiagnosisRequest):
             verdicts = self.diagnosis.check()
             return msg.DiagnosisResult(

@@ -231,6 +231,13 @@ class MasterStateStore:
             state["metrics_store"] = (
                 self._servicer.metrics_store.export_state()
             )
+            # repair-brain plans: a master failover mid-plan must
+            # re-serve the same decided/executing plans (same ids)
+            # instead of re-deciding them — the WAL covers the window
+            # between decision and the next snapshot
+            brain = getattr(self._servicer, "brain", None)
+            if brain is not None:
+                state["brain"] = brain.export_state()
         return state
 
     def write_snapshot(self) -> str | None:
@@ -346,6 +353,9 @@ class MasterStateStore:
                 self._servicer.metrics_store.restore_state(
                     state["metrics_store"]
                 )
+            brain = getattr(self._servicer, "brain", None)
+            if brain is not None and state.get("brain"):
+                brain.restore_state(state["brain"])
 
     def _apply_wal_entry(self, e: dict, snapshot_applied: bool = True):
         op = e.get("op")
@@ -375,6 +385,13 @@ class MasterStateStore:
             self._task_manager.restore_dataset_from_checkpoint(
                 e["content"]
             )
+        elif op == "brain_plan" and self._servicer is not None:
+            brain = getattr(self._servicer, "brain", None)
+            if brain is not None:
+                # absolute plan state: replay upserts by plan id, so
+                # over-replaying the tail around a snapshot boundary
+                # is a no-op and the id counter only moves forward
+                brain.replay_plan(e["plan"], seq=e.get("brain_seq"))
         elif op == "kv" and self._kv_store is not None:
             self._kv_store.set(
                 e["key"], base64.b64decode(e["value"])

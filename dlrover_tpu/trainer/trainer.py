@@ -47,6 +47,13 @@ class TrainingArgs:
     flash_checkpoint: bool = True
     save_steps: int = 0              # 0 = only at end
     save_storage_every: int = 1      # persist every Nth shm save
+    # adopt the master brain's goodput-aware checkpoint cadence
+    # (``ckpt_save_steps`` on the run-config channel): save_steps
+    # becomes a control variable the brain moves toward the Young/Daly
+    # optimum. Only active when cadence saving is already on
+    # (save_steps > 0) and a master is reachable; bounds live with the
+    # brain (master side), the trainer adopts what it is handed.
+    adopt_cadence: bool = True
     # logging/eval
     log_steps: int = 10
     eval_steps: int = 0
@@ -160,6 +167,9 @@ class Trainer:
         # step the on-disk pending/latest prestep sidecar was last
         # serialized at (skip-rewrite cache; None = dirty)
         self._prestep_sidecar_step = None
+        # brain cadence adoption: the master client, probed lazily on
+        # the first log boundary (None = unprobed, False = no master)
+        self._cadence_client = None
         self._engine = None
         if args.flash_checkpoint:
             from dlrover_tpu.trainer.flash_checkpoint.engine import (
@@ -447,6 +457,7 @@ class Trainer:
                             self.global_step, epoch, loss,
                         )
                         telemetry.flush()
+                        self._maybe_adopt_cadence()
                     write_runtime_metrics(self.global_step)
                     if (
                         self._engine is not None
@@ -503,6 +514,56 @@ class Trainer:
                 )
         telemetry.flush()
         return self.state, metrics
+
+    # ------------------------------------------- brain cadence adoption
+
+    def _maybe_adopt_cadence(self):
+        """Adopt the master brain's goodput-aware checkpoint cadence
+        (Young/Daly-tuned ``save_steps``) from the run-config channel.
+        Polled at log cadence, fail-fast and best-effort: no master
+        (or an unreachable one) just keeps the configured value, and
+        adoption never stalls the step loop."""
+        if (
+            not self.args.adopt_cadence
+            or self._engine is None
+            or not self.args.save_steps
+            or self._cadence_client is False
+        ):
+            return
+        if self._cadence_client is None:
+            try:
+                from dlrover_tpu.agent.master_client import (
+                    build_master_client,
+                )
+
+                self._cadence_client = build_master_client() or False
+            except Exception:  # noqa: BLE001 - env without a master
+                self._cadence_client = False
+            if self._cadence_client is False:
+                return
+        try:
+            configs = self._cadence_client.get_elastic_run_config(
+                retries=1
+            )
+        except (ConnectionError, OSError):
+            return
+        except Exception:  # noqa: BLE001 - advisory channel
+            return
+        from dlrover_tpu.master.brain import CADENCE_CONFIG_KEY
+
+        steps = int(configs.get(CADENCE_CONFIG_KEY, 0) or 0)
+        if steps <= 0 or steps == self.args.save_steps:
+            return
+        was = self.args.save_steps
+        self.args.save_steps = steps
+        telemetry.event(
+            "brain.cadence.adopted", save_steps=steps, was=was
+        )
+        telemetry.gauge_set("train.save_steps", steps)
+        logger.info(
+            "adopted brain checkpoint cadence: save_steps %d -> %d",
+            was, steps,
+        )
 
     # ------------------------------------------- live MFU / HBM gauges
 

@@ -1222,8 +1222,11 @@ class TestRepoGate:
             f"  {f.file}:{f.line} [{f.code}] {f.message}" for f in new
         )
         assert bl.unjustified() == []
-        # the gate must stay cheap enough to live in tier-1
-        assert elapsed < 5.0, f"dlint gate took {elapsed:.1f}s"
+        # the gate must stay cheap enough to live in tier-1 (budget
+        # raised 5→8 s after PR 12: the package grew ~1k lines and a
+        # clean run takes ~4 s standalone but 5-6 s under full-suite
+        # neighbor load on this shared VM)
+        assert elapsed < 8.0, f"dlint gate took {elapsed:.1f}s"
 
     def test_baseline_entries_still_anchored(self):
         """Every baseline entry should still correspond to a live

@@ -547,6 +547,103 @@ class TestStateStore:
         assert MasterStateStore.peek_port(str(tmp_path)) == 12345
 
 
+class TestBrainPlanDurability:
+    """A master killed between a ScalePlan decision and its drain ack
+    restarts from snapshot/WAL and re-serves the IDENTICAL plan exactly
+    once — same plan id, no sibling plan, idempotent re-drain."""
+
+    def _world(self, servicer, ranks=(0, 1, 2)):
+        rdzv = servicer.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+        rdzv.update_rdzv_params(2, 16, 0.0, 1)
+        for r in ranks:
+            rdzv.join_rendezvous(r, 1, "127.0.0.1")
+        rdzv.get_comm_world(ranks[0])
+        return rdzv
+
+    @pytest.mark.parametrize("with_snapshot", [True, False])
+    def test_mid_plan_failover_reserves_exactly_once(
+        self, tmp_path, with_snapshot
+    ):
+        import dlrover_tpu.common.messages as msg
+
+        servicer = _build_master_parts()
+        store = _bind_store(servicer, tmp_path)
+        self._world(servicer)
+        deadline = time.time() + 60
+        directive = servicer.get(
+            "worker", 1,
+            msg.PreemptNoticeRequest(
+                node_rank=1, deadline=deadline, lead_s=60.0
+            ),
+        )
+        assert directive.action == "drain"
+        (plan,) = servicer.brain.plans()
+        assert plan.state == "executing"  # decided + drain fired ...
+        # ... and the master dies HERE, before any survivor acked the
+        # reshape (no new round formed). WAL-only or snapshot+WAL:
+        if with_snapshot:
+            store.write_snapshot()
+
+        servicer2 = _build_master_parts()
+        store2 = _bind_store(servicer2, tmp_path)
+        assert store2.restore()
+        restored = [
+            p for p in servicer2.brain.plans()
+            if p.kind == "predictive_drain"
+        ]
+        assert len(restored) == 1
+        assert restored[0].plan_id == directive.plan_id
+        assert restored[0].standing
+
+        # the doomed agent re-sends its notice to the restored master:
+        # the SAME plan comes back, no sibling is minted, and the
+        # re-fired drain is idempotent
+        rdzv2 = self._world(servicer2)
+        directive2 = servicer2.get(
+            "worker", 1,
+            msg.PreemptNoticeRequest(
+                node_rank=1, deadline=deadline, lead_s=55.0
+            ),
+        )
+        assert directive2.plan_id == directive.plan_id
+        assert len([
+            p for p in servicer2.brain.plans()
+            if p.kind == "predictive_drain"
+        ]) == 1
+        # survivors re-form without the doomed host; the plan completes
+        # exactly once
+        rdzv2.get_comm_world(0)
+        _round, members = rdzv2.latest_members()
+        assert 1 not in members
+        servicer2.brain.sweep(
+            {"stragglers": {}, "hangs": {}, "slo": {}}
+        )
+        (restored_plan,) = [
+            p for p in servicer2.brain.plans()
+            if p.kind == "predictive_drain"
+        ]
+        assert restored_plan.state == "done"
+
+    def test_replayed_plan_id_counter_never_remints(self, tmp_path):
+        servicer = _build_master_parts()
+        _bind_store(servicer, tmp_path)
+        self._world(servicer)
+        d1 = servicer.brain.handle_preempt_notice(
+            1, time.time() + 60, 60.0
+        )
+
+        servicer2 = _build_master_parts()
+        store2 = _bind_store(servicer2, tmp_path)
+        store2.restore()
+        self._world(servicer2, ranks=(0, 2, 3))
+        # a DIFFERENT decision on the restored master must not reuse
+        # the lost incarnation's plan id
+        d2 = servicer2.brain.handle_preempt_notice(
+            3, time.time() + 90, 90.0
+        )
+        assert d2["plan_id"] != d1["plan_id"]
+
+
 class TestVerifiedStepsReport:
     def test_refresh_without_dissolving_the_round(self, local_master):
         from dlrover_tpu.agent.master_client import MasterClient

@@ -40,6 +40,12 @@ HEADLINE_KEYS = {
     "reshape_s": "lower",
     "master_rpc_p99_ms": "lower",
     "joins_per_sec": "higher",
+    # week-in-the-life repair-brain arm (tools/chaos_run.py): goodput
+    # with the policy loop on vs off on one seed, and the restart-
+    # bucket seconds an announced preemption's predictive drain saved
+    "goodput_brain_on_pct": "higher",
+    "goodput_brain_off_pct": "higher",
+    "preempt_notice_saved_s": "higher",
 }
 
 

@@ -605,6 +605,576 @@ def _run_scale_flap_inner(
     return 0
 
 
+WEEK_HOST = """
+import json, os, time
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import chaos, telemetry
+from dlrover_tpu.common.chaos import chaos_point
+from dlrover_tpu.common.constants import NodeType, RendezvousName
+
+rank = int(os.environ["WEEK_RANK"])
+inc = int(os.environ.get("WEEK_INC", "0"))
+dt = float(os.environ.get("WEEK_STEP_S", "0.05"))
+slow_rank = int(os.environ.get("WEEK_SLOW_RANK", "-1"))
+slow_after = float(os.environ.get("WEEK_SLOW_AFTER_S", "1e9"))
+slow_factor = float(os.environ.get("WEEK_SLOW_FACTOR", "6.0"))
+save_every = int(os.environ.get("WEEK_SAVE_EVERY", "5"))
+out_dir = os.environ["CHAOS_OUT_DIR"]
+arm = os.environ["WEEK_ARM"]
+stop_file = os.path.join(out_dir, "stop." + arm)
+ckpt_file = os.path.join(out_dir, "ckpt.%s.%d.json" % (arm, rank))
+result_file = os.path.join(
+    out_dir, "result.%s.%d.%d.json" % (arm, rank, inc)
+)
+
+client = MasterClient(
+    os.environ["WEEK_MASTER_ADDR"], rank, NodeType.WORKER
+)
+t_start = time.time()
+
+# toy flash checkpoint: the respawned incarnation resumes here — an
+# announced preemption's pre-drain flush means ZERO replay, an
+# unannounced kill replays back to the last cadence save
+step = 0
+if os.path.exists(ckpt_file):
+    step = int(json.load(open(ckpt_file)).get("step", 0))
+resumed_from = step
+
+
+def stopped():
+    return os.path.exists(stop_file)
+
+
+def save_ckpt():
+    with open(ckpt_file + ".tmp", "w") as f:
+        json.dump({"step": step}, f)
+    os.replace(ckpt_file + ".tmp", ckpt_file)
+
+
+def finish(drained=False, evicted=False, deadline=0.0):
+    with open(result_file, "w") as f:
+        json.dump({
+            "rank": rank, "inc": inc, "steps": step,
+            "resumed_from": resumed_from,
+            "drained": drained, "evicted": evicted,
+            "deadline": deadline,
+        }, f)
+    telemetry.flush()
+    client.close()
+
+
+# join + poll until a formed world contains this rank
+client.join_rendezvous(rank, 1, RendezvousName.ELASTIC_TRAINING)
+world = None
+while not stopped():
+    w = client.get_comm_world(RendezvousName.ELASTIC_TRAINING, rank)
+    if w and w.world and rank in w.world:
+        world = w
+        break
+    time.sleep(0.1)
+if world is None:
+    finish()
+    raise SystemExit(0)
+
+round_, world_size, sync_i = world.round, len(world.world), 0
+last_hb = last_ship = last_world = 0.0
+evicted_out = False
+
+
+def adopt(w, stall_s):
+    # surviving member: adopt the new round IN PROCESS (the real
+    # machinery is PR 9's reshaper; this sim prices the stall)
+    global round_, world_size, sync_i
+    telemetry.event(
+        "elastic.reshape", round=w.round, dur=max(stall_s, 0.001)
+    )
+    round_, world_size, sync_i = w.round, len(w.world), 0
+
+
+def excluded(w):
+    # a round FORMED (round advanced) and this rank is not in it:
+    # evicted. An empty world at our own round number is just a
+    # dissolution in progress — keep waiting.
+    return w is not None and w.round != round_ and (
+        (w.world and rank not in w.world) or not w.world
+    )
+
+
+while not stopped():
+    # announced-preemption seam: the chaos ``notice`` action fires here
+    # (time-anchored via ``elapsed``) and arms the deadline kill;
+    # consuming the notice buys the lead window for the brain-directed
+    # drain
+    chaos_point(
+        "preempt.notice", rank=rank,
+        elapsed=time.time() - t_start,
+    )
+    note = chaos.take_preempt_notice()
+    if note is not None:
+        deadline = float(note["deadline"])
+        lead = max(deadline - time.time(), 0.0)
+        telemetry.event("preempt.notice", rank=rank, lead=lead)
+        directive = None
+        try:
+            directive = client.report_preempt_notice(
+                rank, deadline, lead
+            )
+        except Exception:
+            pass
+        if directive is not None and \\
+                getattr(directive, "action", "") == "drain":
+            t0 = time.monotonic()
+            try:
+                client.drain_node(rank)
+            except Exception:
+                pass
+            save_ckpt()  # the pre-drain flush: zero replay
+            telemetry.event(
+                "elastic.drained", rank=rank,
+                dur=time.monotonic() - t0, deadline=deadline,
+            )
+            finish(drained=True, deadline=deadline)
+            raise SystemExit(0)
+        # directive "none" / master unreachable: keep training until
+        # the armed kill lands (the unannounced fallback path)
+    now = time.time()
+    if now - last_hb > 0.5:
+        # heartbeats drive the master's diagnosis + brain sweep
+        try:
+            client.report_heart_beat()
+        except Exception:
+            pass
+        last_hb = now
+    if now - last_world > 0.5:
+        # steady-state membership poll: catches joins (scale-out) that
+        # never stall the barrier, and our own eviction
+        last_world = now
+        try:
+            w = client.get_comm_world(
+                RendezvousName.ELASTIC_TRAINING, rank
+            )
+        except Exception:
+            w = None
+        if excluded(w):
+            evicted_out = True
+            break
+        if w is not None and w.world and w.round != round_ and \\
+                rank in w.world:
+            adopt(w, 0.0)
+    # lockstep step barrier through the master kv-store: a dead peer
+    # never arrives, so survivors genuinely STALL until the membership
+    # change propagates — the cost the predictive drain removes
+    key = "week:%s:r%d:s%d" % (arm, round_, sync_i)
+    t_bar = time.monotonic()
+    try:
+        n = client.kv_store_add(key, 1)
+    except Exception:
+        n = 0
+    new_world = None
+    while n < world_size and not stopped():
+        time.sleep(0.03)
+        try:
+            w = client.get_comm_world(
+                RendezvousName.ELASTIC_TRAINING, rank
+            )
+        except Exception:
+            w = None
+        if excluded(w):
+            evicted_out = True
+            break
+        if w is not None and w.world and w.round != round_:
+            new_world = w
+            break
+        try:
+            n = client.kv_store_add(key, 0)
+        except Exception:
+            pass
+    if stopped() or evicted_out:
+        break
+    if new_world is not None:
+        if rank not in new_world.world:
+            evicted_out = True
+            break
+        adopt(new_world, time.monotonic() - t_bar)
+        continue
+    this_dt = dt
+    if rank == slow_rank and time.time() - t_start >= slow_after:
+        this_dt = dt * slow_factor
+    time.sleep(this_dt)
+    step += 1
+    sync_i += 1
+    telemetry.event("step.end", step=step, dur=this_dt)
+    telemetry.gauge_set(
+        "timer.phase.recent_avg_ms", this_dt * 1e3, phase="step"
+    )
+    telemetry.gauge_set(
+        "timer.phase.avg_ms", this_dt * 1e3, phase="step"
+    )
+    if step % save_every == 0:
+        save_ckpt()
+        telemetry.event("ckpt.save", step=step, dur=0.01)
+    if time.time() - last_ship > 0.7:
+        snap = telemetry.snapshot()
+        if snap is not None:
+            try:
+                client.report_telemetry(snap)
+            except Exception:
+                pass
+        telemetry.flush()
+        last_ship = time.time()
+
+finish(evicted=evicted_out)
+"""
+
+
+def run_week_arm(out_dir: str, arm: str, schedule: dict, cfg: dict) -> dict:
+    """One week-in-the-life arm: an in-process master (repair brain on
+    or off per ``cfg['brain']``), subprocess hosts in a kv-store
+    lockstep barrier, and this harness playing the PLATFORM — spawning
+    hosts, detecting unannounced deaths (simulated heartbeat timeout ->
+    ``remove_alive_node``), respawning replacements, and driving the
+    scale-out joiner. Returns the arm's ledger, plan summary and
+    respawn accounting."""
+    from dlrover_tpu.common import telemetry
+    from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+    from dlrover_tpu.common.telemetry import JobTelemetry
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.scheduler.job import new_job_args
+
+    arm_dir = os.path.join(out_dir, f"week_{arm}")
+    tele_dir = os.path.join(arm_dir, "telemetry")
+    os.makedirs(tele_dir, exist_ok=True)
+    # per-arm master AND a fresh telemetry registry: the two arms'
+    # ledgers must never contaminate each other
+    os.environ["DLROVER_TELEMETRY_DIR"] = tele_dir
+    os.environ["DLROVER_TELEMETRY_ROLE"] = "master"
+    os.environ["DLROVER_BRAIN"] = "1" if cfg.get("brain", True) else "0"
+    telemetry.enable()
+    master = LocalJobMaster(0, new_job_args("local", f"week-{arm}"))
+    master.prepare()
+    rdzv = master.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+    rdzv.update_rdzv_params(
+        cfg.get("min_nodes", 2), 16, cfg.get("rdzv_wait", 1.0), 1
+    )
+
+    script = os.path.join(arm_dir, "week_host.py")
+    with open(script, "w") as f:
+        f.write(WEEK_HOST)
+    stop_file = os.path.join(arm_dir, f"stop.{arm}")
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+
+    def spawn(rank: int, inc: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH")) if p
+        )
+        env.update({
+            "WEEK_MASTER_ADDR": master.addr,
+            "WEEK_RANK": str(rank),
+            "WEEK_INC": str(inc),
+            "WEEK_ARM": arm,
+            "WEEK_STEP_S": str(cfg.get("dt", 0.05)),
+            "NODE_RANK": str(rank),
+            "DLROVER_TELEMETRY_ROLE": "worker",
+            "DLROVER_TELEMETRY_DIR": tele_dir,
+            "CHAOS_OUT_DIR": arm_dir,
+            "JAX_PLATFORMS": "cpu",
+        })
+        slow = cfg.get("slow") or {}
+        env["WEEK_SLOW_RANK"] = str(slow.get("rank", -1))
+        env["WEEK_SLOW_AFTER_S"] = str(slow.get("after_s", 1e9))
+        env["WEEK_SLOW_FACTOR"] = str(slow.get("factor", 6.0))
+        if inc == 0:
+            env["DLROVER_CHAOS"] = json.dumps(schedule)
+        else:
+            # one-shot faults: a respawned incarnation re-arming the
+            # schedule would reset the rule counters and die again
+            env.pop("DLROVER_CHAOS", None)
+        env.pop(NodeEnv.DLROVER_MASTER_ADDR_FILE, None)
+        log = open(
+            os.path.join(arm_dir, f"host.{rank}.{inc}.log"), "ab"
+        )
+        proc = subprocess.Popen(  # noqa: S603
+            [sys.executable, script], env=env, stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+        log.close()
+        return proc
+
+    def result_of(rank: int, inc: int) -> dict | None:
+        path = os.path.join(
+            arm_dir, f"result.{arm}.{rank}.{inc}.json"
+        )
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    hosts = int(cfg.get("hosts", 3))
+    procs: dict[int, subprocess.Popen | None] = {}
+    incs = {r: 0 for r in range(hosts)}
+    respawns = {r: 0 for r in range(hosts)}
+    evicted: set[int] = set()
+    drained_ranks: set[int] = set()
+    # rank -> (respawn_at_wall, needs_removal)
+    pending: dict[int, tuple[float, bool]] = {}
+    for r in range(hosts):
+        procs[r] = spawn(r, 0)
+    scaled = False
+    t0 = time.time()
+    t_end = t0 + float(cfg.get("duration_s", 26.0))
+    detect_s = float(cfg.get("detect_s", 1.5))
+    try:
+        while time.time() < t_end:
+            time.sleep(0.15)
+            now = time.time()
+            scale_at = cfg.get("scale_out_at_s")
+            if scale_at and not scaled and now - t0 >= scale_at:
+                scaled = True
+                r = hosts
+                incs[r] = 0
+                respawns[r] = 0
+                procs[r] = spawn(r, 0)
+            for r, p in list(procs.items()):
+                if p is None or p.poll() is None:
+                    continue
+                res = result_of(r, incs[r])
+                procs[r] = None
+                if res and res.get("evicted"):
+                    # the brain shot this straggler; the platform would
+                    # replace it on another host — out of scope here
+                    evicted.add(r)
+                    continue
+                if res and res.get("drained"):
+                    # graceful predictive drain: the replacement shows
+                    # up once the announced deadline has passed
+                    drained_ranks.add(r)
+                    pending[r] = (
+                        max(now, float(res.get("deadline", now)))
+                        + 0.3,
+                        False,
+                    )
+                else:
+                    # unannounced death: the platform notices via
+                    # heartbeat timeout, removes the node (survivors
+                    # stall until then), then relaunches it
+                    pending[r] = (now + detect_s, True)
+            for r, (at, needs_removal) in list(pending.items()):
+                if now < at:
+                    continue
+                del pending[r]
+                if needs_removal:
+                    rdzv.remove_alive_node(r)
+                incs[r] += 1
+                respawns[r] += 1
+                procs[r] = spawn(r, incs[r])
+    finally:
+        with open(stop_file, "w") as f:
+            f.write("stop")
+        deadline = time.time() + 30
+        for p in procs.values():
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=max(deadline - time.time(), 1.0))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        plans = master.servicer.brain.summary()
+        master.stop()
+        telemetry.flush()
+    report = JobTelemetry.from_dir(tele_dir).report()
+    ledger = report["ledger"]
+    # fleet throughput goodput: achieved steps over the ideal the
+    # initial fleet could have produced in the window. The ledger's
+    # collapsed utilization view ("was ANYONE productive") cannot see a
+    # fleet slowed 6x by a straggler or stalled survivors overlapped by
+    # the slow host's own long steps — steps/ideal can, and it is what
+    # the brain's policies actually move.
+    results_by_rank: dict[int, list[dict]] = {}
+    for name in os.listdir(arm_dir):
+        if not name.startswith(f"result.{arm}."):
+            continue
+        try:
+            with open(os.path.join(arm_dir, name)) as f:
+                res = json.load(f)
+        except (OSError, ValueError):
+            continue
+        results_by_rank.setdefault(
+            int(res.get("rank", -1)), []
+        ).append(res)
+    steps_by_rank: dict[int, int] = {}
+    replay_by_rank: dict[int, int] = {}
+    for r, results in results_by_rank.items():
+        results.sort(key=lambda x: int(x.get("inc", 0)))
+        steps_by_rank[r] = max(
+            int(x.get("steps", 0)) for x in results
+        )
+        # a respawned incarnation resumed at its checkpoint: the
+        # predecessor's steps past that point were replayed work
+        replay_by_rank[r] = sum(
+            max(
+                int(prev.get("steps", 0))
+                - int(cur.get("resumed_from", 0)),
+                0,
+            )
+            for prev, cur in zip(results, results[1:])
+        )
+    dt = float(cfg.get("dt", 0.05))
+    duration = float(cfg.get("duration_s", 26.0))
+    ideal = (duration / dt) * hosts
+    steps_total = sum(steps_by_rank.values())
+    goodput_pct = (
+        100.0 * min(steps_total / ideal, 1.0) if ideal > 0 else 0.0
+    )
+    return {
+        "arm": arm,
+        "brain": cfg.get("brain", True),
+        "goodput_pct": round(goodput_pct, 3),
+        "steps_total": steps_total,
+        "steps_by_rank": steps_by_rank,
+        "replay_by_rank": replay_by_rank,
+        "dt": dt,
+        "ledger_goodput_pct": round(
+            ledger.get("goodput", 0.0) * 100, 3
+        ),
+        "total_s": round(ledger.get("total_s", 0.0), 3),
+        "categories": {
+            k: round(v, 3)
+            for k, v in (ledger.get("categories") or {}).items()
+        },
+        "plans": plans,
+        "respawns": respawns,
+        "evicted": sorted(evicted),
+        "drained": sorted(drained_ranks),
+        "telemetry_dir": tele_dir,
+        "timeline": [
+            {
+                "t": ev.get("t"), "kind": ev.get("kind"),
+                "source": ev.get("source"), "dur": ev.get("dur"),
+                "rank": ev.get("rank"),
+            }
+            for ev in report.get("timeline", ())
+            if ev.get("kind") in (
+                "preempt.notice", "elastic.reshape",
+                "elastic.drained", "chaos.fire",
+            )
+        ],
+    }
+
+
+def _run_week(schedule: dict, out_dir: str, steps: int) -> int:
+    """The week-in-the-life proof: the SAME seed brain-on and
+    brain-off. Announced preemption, hard kill, persistent straggler,
+    scale-out; publishes goodput_brain_on_pct / goodput_brain_off_pct
+    / preempt_notice_saved_s (gated by tools/bench_diff.py) and
+    asserts the brain-on contract."""
+    cfg = {
+        "hosts": 3,
+        "dt": 0.05,
+        "duration_s": max(float(steps), 10.0) * 2.8,
+        "min_nodes": 2,
+        "rdzv_wait": 1.0,
+        "detect_s": 1.5,
+        "slow": {"rank": 2, "after_s": 9.0, "factor": 6.0},
+        "scale_out_at_s": 20.0,
+    }
+    on = run_week_arm(out_dir, "on", schedule, {**cfg, "brain": True})
+    off = run_week_arm(out_dir, "off", schedule, {**cfg, "brain": False})
+
+    def preempt_cost(arm: dict, victim: int) -> float:
+        """Seconds the announced preemption cost this arm: the worst
+        survivor stall (elastic.reshape dur) inside the 10 s after the
+        victim's preempt.notice event, plus the victim's replayed
+        work."""
+        notices = [
+            ev["t"] for ev in arm["timeline"]
+            if ev["kind"] == "preempt.notice"
+            and ev.get("rank") == victim and ev.get("t")
+        ]
+        stall = 0.0
+        if notices:
+            t0 = min(notices)
+            stall = max(
+                (
+                    float(ev.get("dur") or 0.0)
+                    for ev in arm["timeline"]
+                    if ev["kind"] == "elastic.reshape"
+                    and ev.get("t") is not None
+                    and t0 <= ev["t"] <= t0 + 10.0
+                ),
+                default=0.0,
+            )
+        replay = arm["replay_by_rank"].get(victim, 0) * arm["dt"]
+        return stall + replay
+
+    victim = next(
+        (
+            int(r.get("rank", -1))
+            for r in schedule.get("rules", ())
+            if r.get("action") == "notice"
+        ),
+        1,
+    )
+    saved = max(
+        preempt_cost(off, victim) - preempt_cost(on, victim), 0.0
+    )
+    keys = {
+        "goodput_brain_on_pct": on["goodput_pct"],
+        "goodput_brain_off_pct": off["goodput_pct"],
+        "preempt_notice_saved_s": round(saved, 3),
+    }
+    result = {"keys": keys, "on": on, "off": off}
+    with open(os.path.join(out_dir, "week_report.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    print("\n=== week-in-the-life ===")
+    for arm in (on, off):
+        print(
+            f"brain={'on ' if arm['brain'] else 'off'} goodput "
+            f"{arm['goodput_pct']:6.2f}%  categories={arm['categories']}"
+            f"  respawns={arm['respawns']}  evicted={arm['evicted']}"
+        )
+    print(f"bench keys: {json.dumps(keys)}")
+
+    failures = []
+    done_kinds = {
+        p["kind"] for p in on["plans"].get("recent", ())
+        if p["state"] == "done"
+    }
+    if "predictive_drain" not in done_kinds:
+        failures.append("no predictive_drain plan completed (brain on)")
+    if "evict_straggler" not in done_kinds:
+        failures.append("the persistent straggler was never evicted")
+    if 2 not in on["evicted"]:
+        failures.append("straggler host (rank 2) did not exit evicted")
+    if 1 not in on["drained"]:
+        failures.append(
+            "the announced preemption (rank 1) was not pre-drained"
+        )
+    # zero survivor restarts on the announced preemption: only the two
+    # victims (rank 0 hard kill, rank 1 preemption) may respawn
+    survivors_respawned = {
+        r: n for r, n in on["respawns"].items()
+        if n and r not in (0, 1)
+    }
+    if survivors_respawned:
+        failures.append(
+            f"survivor host(s) restarted: {survivors_respawned}"
+        )
+    if on["goodput_pct"] <= off["goodput_pct"]:
+        failures.append(
+            f"goodput brain-on ({on['goodput_pct']}%) did not beat "
+            f"brain-off ({off['goodput_pct']}%)"
+        )
+    for f_ in failures:
+        print(f"FAIL: {f_}")
+    if not failures:
+        print("week-in-the-life: PASS")
+    return 1 if failures else 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -665,6 +1235,13 @@ def main() -> int:
         chaos.install(schedule)
 
     if any(
+        r.get("site") == "preempt.notice"
+        for r in schedule.get("rules", [])
+    ):
+        # repair-brain harness: in-process master + subprocess hosts,
+        # same seed brain-on vs brain-off
+        rc = _run_week(schedule, out_dir, args.steps)
+    elif any(
         r.get("site") == "master.kill"
         for r in schedule.get("rules", [])
     ):

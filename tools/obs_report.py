@@ -72,6 +72,9 @@ def build_report(
     report["control_plane"] = _control_plane_summary(
         report.get("metrics", {}), report.get("ledger", {})
     )
+    report["brain"] = _brain_summary(
+        report.get("metrics", {}), report.get("timeline", [])
+    )
     if trace_dir:
         try:
             from tools.parse_profile import summarize
@@ -148,6 +151,41 @@ def _reshape_summary(metrics: dict, ledger: dict) -> dict:
     reshape_s = (ledger.get("categories") or {}).get("reshape", 0.0)
     if reshape_s or out:
         out["ledger_reshape_s"] = round(float(reshape_s), 3)
+    return out
+
+
+def _brain_summary(metrics: dict, timeline: list) -> dict:
+    """Repair-brain actions at a glance: plan counters (decided /
+    executing / done / abandoned per kind), the published checkpoint
+    cadence, and the recent ``brain.plan.*`` transition tail with
+    outcomes — the offline twin of the dashboard's brain panel."""
+    out: dict = {"counters": {}, "plans": []}
+    for c in metrics.get("counters", ()):
+        if c["name"].startswith("brain."):
+            labels = c.get("labels") or {}
+            label_s = ",".join(
+                f"{k}={v}" for k, v in sorted(labels.items())
+            )
+            key = c["name"] + (f"{{{label_s}}}" if label_s else "")
+            out["counters"][key] = c["value"]
+    for g in metrics.get("gauges", ()):
+        if g["name"].startswith("brain."):
+            out["counters"][g["name"]] = g["value"]
+    for ev in timeline:
+        kind = str(ev.get("kind", ""))
+        if not kind.startswith("brain.plan."):
+            continue
+        out["plans"].append({
+            "t": ev.get("t"),
+            "plan": ev.get("plan"),
+            "plan_kind": ev.get("plan_kind", ""),
+            "transition": kind.rsplit(".", 1)[-1],
+            "target": ev.get("target"),
+        })
+    # keep the tail: the dashboards show the last K, so does the report
+    out["plans"] = out["plans"][-16:]
+    if not out["counters"] and not out["plans"]:
+        return {}
     return out
 
 
@@ -375,6 +413,26 @@ def main(argv=None) -> int:
             print("\n=== elastic reshape (restart-free scale events) ===")
             for name in sorted(reshape):
                 print(f"{reshape[name]:14.3f}  {name}")
+        brain = report.get("brain") or {}
+        if brain:
+            print("\n=== brain actions (repair plans) ===")
+            for name in sorted(brain.get("counters", {})):
+                print(f"{brain['counters'][name]:14.3f}  {name}")
+            plans = brain.get("plans") or []
+            if plans:
+                t0 = plans[0].get("t") or 0.0
+                for p in plans:
+                    target = (
+                        f" rank={p['target']}"
+                        if p.get("target", -1) is not None
+                        and p.get("target", -1) >= 0 else ""
+                    )
+                    print(
+                        f"+{(p.get('t') or 0.0) - t0:9.3f}s  "
+                        f"{p.get('plan', '?'):<10} "
+                        f"{p.get('plan_kind', ''):<18}"
+                        f"{target:<10} -> {p.get('transition', '')}"
+                    )
         control = report.get("control_plane") or {}
         if control:
             print("\n=== control plane (master RPC surface) ===")
