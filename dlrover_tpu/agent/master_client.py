@@ -355,6 +355,58 @@ class MasterClient:
         )
         return res.series if res else []
 
+    # ------------------------------------------------------------- serving
+
+    def serve_submit(
+        self,
+        request_id: str,
+        prompt,
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        eos_id: int = -1,
+    ) -> bool:
+        """Submit one generation request to the master's serving
+        ledger (idempotent by request_id — retries after a dropped ack
+        cannot double-serve)."""
+        return self._report(
+            msg.ServeSubmitRequest(
+                request_id=request_id,
+                prompt=list(prompt),
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                eos_id=eos_id,
+            )
+        )
+
+    def serve_lease(self, max_requests: int) -> list:
+        """Pull up to ``max_requests`` queued requests for this decode
+        worker (payload dicts; the lease deadline lives on the
+        master)."""
+        res: msg.ServeLease = self._get(
+            msg.ServeLeaseRequest(
+                node_rank=self._node_id, max_requests=max_requests
+            )
+        )
+        return list(res.requests) if res else []
+
+    def serve_report_result(self, request_id: str, tokens,
+                            finish_reason: str = "") -> bool:
+        return self._report(
+            msg.ServeResultReport(
+                request_id=request_id,
+                node_rank=self._node_id,
+                tokens=list(tokens),
+                finish_reason=finish_reason,
+            )
+        )
+
+    def serve_status(self) -> dict:
+        res: msg.ServeStatus = self._get(msg.ServeStatusRequest())
+        return dict(res.summary) if res else {}
+
+    def serve_fetch(self, request_id: str) -> msg.ServeResult:
+        return self._get(msg.ServeFetchRequest(request_id=request_id))
+
     def report_node_meta(
         self, node_rank: int, addr: str, tpu_chips: int = 0
     ) -> bool:

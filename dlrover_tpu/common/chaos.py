@@ -8,7 +8,8 @@ This module is the one place every fault comes from: named **fault
 sites** threaded through the control plane (``rpc.send``, ``rpc.recv``,
 ``ipc.request``, ``agent.spawn``, ``ckpt.write``, ``ckpt.manifest``,
 ``ckpt.save``, ``rdzv.join``, ``master.kill``, ``elastic.signal``,
-``elastic.reshape``, ``preempt.notice``, ``brain.plan``) consult a
+``elastic.reshape``, ``preempt.notice``, ``brain.plan``,
+``serve.admit``, ``serve.step``) consult a
 seeded schedule
 that can drop or
 delay RPC frames, kill or hang a process at a chosen step, tear a
@@ -630,6 +631,33 @@ NAMED_SCHEDULES: dict[str, dict] = {
                 "action": "kill",
                 "rank": 0,
                 "at": 14.0,
+                "max": 1,
+            },
+        ],
+    },
+    # kill one decode worker mid-sweep: the serving arm's availability
+    # proof. The worker dies on its 4th SERVING step (rank 1, counted
+    # on the worker's own call sequence — deterministic per schedule),
+    # abandoning its leased requests un-reported; the master's lease
+    # expiry must re-queue each of them exactly once onto the
+    # survivors, throughput degrades instead of requests dropping, and
+    # the ledger ends with zero failed / zero double-served requests.
+    # Driven by tools/chaos_run.py ``_run_serve_kill``, which publishes
+    # serve_tokens_per_s / serve_ttft_p50_ms / serve_ttft_p99_ms /
+    # serve_goodput_pct (gated by tools/bench_diff.py).
+    "serve-kill": {
+        "desc": "kill one decode worker mid-sweep; its leased requests "
+        "must re-queue exactly once onto the survivors — throughput "
+        "degrades, nothing is dropped or double-served; publishes the "
+        "serve_* bench keys",
+        "seed": 41,
+        "rules": [
+            {
+                "site": "serve.step",
+                "action": "error",
+                "rank": 1,
+                "verb": "serving",
+                "after": 3,
                 "max": 1,
             },
         ],

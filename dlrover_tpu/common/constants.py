@@ -119,6 +119,10 @@ class JobExitReason:
 class RendezvousName:
     ELASTIC_TRAINING = "elastic-training"
     NETWORK_CHECK = "network-check"
+    # the elastic serving arm's decode workers join the SAME master
+    # through this node group (role=decode): liveness, drain/removal,
+    # failover and chaos all ride the existing rendezvous paths
+    DECODE_POOL = "decode-pool"
 
 
 class NetworkFailureReason:

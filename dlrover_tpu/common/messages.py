@@ -402,6 +402,79 @@ class BarrierResponse(Message):
 
 
 # --------------------------------------------------------------------------
+# elastic serving (continuous-batching decode pool)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ServeSubmitRequest(Message):
+    """A generation request entering the serving front door. The
+    master's request ledger (serving/manager.py) owns it from here:
+    queued -> leased -> done, with exactly-once re-queue if the
+    leasing decode worker dies."""
+
+    request_id: str = ""
+    prompt: list = field(default_factory=list)
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    eos_id: int = -1
+
+
+@dataclass
+class ServeLeaseRequest(Message):
+    """A decode worker with free slots pulls queued requests. The
+    lease carries a deadline on the master side — a worker that dies
+    stops reporting and its leases re-queue."""
+
+    node_rank: int = 0
+    max_requests: int = 1
+
+
+@dataclass
+class ServeLease(Message):
+    requests: list = field(default_factory=list)  # request payload dicts
+    queue_depth: int = 0
+
+
+@dataclass
+class ServeResultReport(Message):
+    """A finished continuation. Only the CURRENT leaseholder's report
+    lands (double-serve guard); a zombie worker's late report is
+    acknowledged-and-dropped."""
+
+    request_id: str = ""
+    node_rank: int = 0
+    tokens: list = field(default_factory=list)
+    finish_reason: str = ""
+
+
+@dataclass
+class ServeStatusRequest(Message):
+    pass
+
+
+@dataclass
+class ServeStatus(Message):
+    """The ledger summary the dashboard/obs_report render: queue
+    depth, live pool size, per-state counts, per-worker served."""
+
+    summary: dict = field(default_factory=dict)
+
+
+@dataclass
+class ServeFetchRequest(Message):
+    request_id: str = ""
+
+
+@dataclass
+class ServeResult(Message):
+    request_id: str = ""
+    state: str = "unknown"  # queued | leased | done | failed | unknown
+    tokens: list = field(default_factory=list)
+    finish_reason: str = ""
+
+
+# --------------------------------------------------------------------------
 # kv-store (the rendezvous store the workers share)
 # --------------------------------------------------------------------------
 

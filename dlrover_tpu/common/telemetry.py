@@ -141,6 +141,19 @@ def median_baseline(values) -> float:
     return (values[n // 2 - 1] + values[n // 2]) / 2
 
 
+def nearest_rank_percentile(values, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]) of an unsorted iterable,
+    0.0 when empty. One definition shared by the serving SLO rule
+    (``metrics_store.SloWatchdog``) and the load generator's headline
+    TTFT keys (``serving/loadgen.py``) so the gate and the bench can
+    never drift."""
+    ordered = sorted(values)
+    if not ordered:
+        return 0.0
+    k = min(int(q * len(ordered)), len(ordered) - 1)
+    return float(ordered[k])
+
+
 def sum_bucket_counts(hists):
     """Element-wise sum of le-bucket histogram series (snapshot-dict
     shape: ``{"bounds": [...], "counts": [...]}``). The first series'

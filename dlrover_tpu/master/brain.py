@@ -74,6 +74,14 @@ CADENCE_DEADBAND = 0.25
 # followed by its own deadline kill is ONE failure, not two)
 _FAILURE_CLUSTER_S = 30.0
 
+# serving pool policy: queue depth above this for PERSIST_SWEEPS
+# consecutive sweeps (or a standing serve_* SLO breach) is sustained
+# load pressure -> a scale-out plan for the decode pool
+SERVE_QUEUE_HOT = int(os.environ.get("DLROVER_BRAIN_SERVE_QUEUE", "8"))
+# the serving SLO rules that count as pool pressure through the
+# watchdog sensor (metrics_store.SloWatchdog)
+_SERVE_SLO_RULES = ("serve_ttft_p99", "serve_queue_depth")
+
 # the run-config key trainers poll for (Trainer._maybe_adopt_cadence)
 CADENCE_CONFIG_KEY = "ckpt_save_steps"
 
@@ -144,6 +152,7 @@ class RepairBrain:
             CADENCE_MIN_STEPS, CADENCE_MAX_STEPS,
         ),
         enabled: bool | None = None,
+        serve_queue_hot: int = SERVE_QUEUE_HOT,
     ):
         self._servicer = servicer
         self._rdzv = rdzv_manager
@@ -177,6 +186,10 @@ class RepairBrain:
         self._last_plan_t: dict[str, float] = {}
         self._last_cadence_t = 0.0
         self._cadence_published = 0
+        # serving pool policy: consecutive sweeps the decode queue (or
+        # a serve_* SLO breach) showed sustained pressure
+        self._serve_queue_hot = max(int(serve_queue_hot), 1)
+        self._pool_streak = 0
 
     # ------------------------------------------------------------ plumbing
 
@@ -293,6 +306,7 @@ class RepairBrain:
             return
         self._update_suspects(verdicts)
         self._maybe_evict(now)
+        self._maybe_scale_pool(verdicts, now)
         self._maybe_retune_cadence(now)
 
     def _update_suspects(self, verdicts: dict):
@@ -357,6 +371,64 @@ class RepairBrain:
             # the pre-crash drain
             self._execute_drain(plan)
 
+    def _maybe_scale_pool(self, verdicts: dict, now: float):
+        """Elasticity driven by LOAD, not failures: sustained decode
+        queue depth (or a standing serving SLO breach — TTFT p99 /
+        queue ceiling through the watchdog sensor) turns into a
+        WAL-durable scale-out plan for the decode pool. The plan's
+        actuator is the platform scaler (or the operator) adding a
+        worker; it completes when the ledger sees the pool at the
+        planned size, and abandons past its deadline like every other
+        plan."""
+        servicer = self._servicer
+        serving = getattr(servicer, "serving", None)
+        if serving is None:
+            return
+        depth = serving.queue_depth()
+        slo = verdicts.get("slo") or {}
+        hot = depth > self._serve_queue_hot or any(
+            str(info.get("rule", "")) in _SERVE_SLO_RULES
+            for info in slo.values()
+        )
+        with self._lock:
+            self._pool_streak = self._pool_streak + 1 if hot else 0
+            streak = self._pool_streak
+            last = self._last_plan_t.get("scale_decode_pool", 0.0)
+            # one standing scale-out at a time: the key below is
+            # derived from the LIVE pool size, so a pool dip while a
+            # plan is pending would otherwise mint a sibling with a
+            # different target
+            pending = any(
+                p.kind == "scale_decode_pool" and p.standing
+                for p in self._plans.values()
+            )
+        if pending:
+            return
+        if streak < self._persist_sweeps:
+            return
+        if now - last < self._cooldown:
+            return
+        pool = serving.pool_size()
+        want = pool + 1
+        plan, fresh = self._decide(
+            "scale_decode_pool", -1,
+            # keyed by the target size: re-observed pressure while the
+            # scale-out is pending re-serves the same plan instead of
+            # minting a sibling every sweep
+            key=f"serve_pool:{want}", now=now,
+            detail={
+                "pool": pool,
+                "want": want,
+                "queue_depth": depth,
+                "slo_keys": ",".join(sorted(
+                    k for k, i in slo.items()
+                    if str(i.get("rule", "")) in _SERVE_SLO_RULES
+                )),
+            },
+        )
+        if fresh:
+            telemetry.gauge_set("brain.serve.pool_want", want)
+
     def _progress_plans(self, now: float):
         """Standing plans complete when a round formed after the
         decision no longer carries the target (or records its drained
@@ -368,6 +440,22 @@ class RepairBrain:
                 p for p in self._plans.values() if p.standing
             ]
         for plan in standing:
+            if plan.kind == "scale_decode_pool":
+                serving = getattr(self._servicer, "serving", None)
+                want = int(plan.detail.get("want", 0))
+                if serving is not None and want and \
+                        serving.pool_size() >= want:
+                    self._transition(
+                        plan, "done", pool=serving.pool_size()
+                    )
+                    with self._lock:
+                        self._pool_streak = 0
+                    continue
+                if now > plan.deadline:
+                    self._transition(
+                        plan, "abandoned", reason="timeout"
+                    )
+                continue
             if plan.kind == "cadence":
                 # cadence plans complete at publish time; a standing
                 # one (failover inside the decide->publish window whose

@@ -148,6 +148,29 @@ def render_prometheus(servicer) -> str:
                 {"key": key, "rule": info.get("rule", "")}, 1,
                 "standing SLO breaches (1 per active breach)", "gauge",
             )
+    serving = getattr(servicer, "serving", None)
+    if serving is not None:
+        s = serving.summary()
+        sample(
+            "dlrtpu_serve_queue_depth", {}, s.get("queue_depth", 0),
+            "decode requests queued on the master ledger", "gauge",
+        )
+        sample(
+            "dlrtpu_serve_pool_size", {}, s.get("pool_size", 0),
+            "decode workers with recent lease/report activity",
+            "gauge",
+        )
+        for state, n in sorted((s.get("counts") or {}).items()):
+            sample(
+                "dlrtpu_serve_requests", {"state": str(state)}, n,
+                "serving requests by ledger state", "gauge",
+            )
+        for rank, w in sorted((s.get("workers") or {}).items()):
+            sample(
+                "dlrtpu_serve_worker_served", {"worker": rank},
+                w.get("served", 0),
+                "requests served per decode worker", "gauge",
+            )
     brain = getattr(servicer, "brain", None)
     if brain is not None:
         s = brain.summary()
@@ -198,6 +221,10 @@ class MasterHttpPlane:
         report["slo"] = verdicts.get("slo", {})
         brain = getattr(self._servicer, "brain", None)
         report["brain"] = brain.summary() if brain is not None else {}
+        serving = getattr(self._servicer, "serving", None)
+        report["serving"] = (
+            serving.summary() if serving is not None else {}
+        )
         return report
 
     def series_payload(self, query: dict) -> dict:
@@ -327,6 +354,9 @@ DASHBOARD_HTML = """<!doctype html>
 <div id="steps"></div>
 <h2>MFU (train.mfu, per source)</h2><div id="mfu"></div>
 <h2>SLO breaches</h2><div id="slo" class="ok">none</div>
+<h2>serving (decode pool)</h2><pre id="serving">no serving arm</pre>
+<h2>serving TTFT (serve.ttft.last_s, per worker)</h2>
+<div id="ttft"></div>
 <h2>brain (repair plans)</h2><pre id="brain">none</pre>
 <h2>recent events (reshape / restart / ckpt / slo / diagnosis / brain)</h2>
 <pre id="events"></pre>
@@ -388,6 +418,21 @@ async function tick() {
       slo.textContent = breaches.map(
         ([k, v]) => k + ' ' + JSON.stringify(v)).join('\\n');
     } else { slo.className = 'ok'; slo.textContent = 'none'; }
+    const serving = rep.serving || {};
+    const sEl = document.getElementById('serving');
+    if (Object.keys(serving).length) {
+      const counts = serving.counts || {};
+      sEl.textContent =
+        'queue=' + (serving.queue_depth || 0) +
+        '  pool=' + (serving.pool_size || 0) +
+        '  done=' + (counts.done || 0) +
+        '  leased=' + (counts.leased || 0) +
+        '  failed=' + (counts.failed || 0) +
+        '  requeued=' + (counts.requeued_total || 0) +
+        '\\n' + Object.entries(serving.workers || {}).map(
+          ([rank, w]) => 'worker ' + rank + ': served=' + w.served +
+            ' idle=' + w.idle_s + 's').join('\\n');
+    }
     const brain = rep.brain || {};
     const plans = brain.recent || [];
     const bEl = document.getElementById('brain');
@@ -406,7 +451,7 @@ async function tick() {
       bEl.textContent = 'enabled=' + (brain.enabled !== false) +
         '  (no plans yet)';
     }
-    const interesting = /^(elastic\\.|master\\.|ckpt\\.restore|rdzv\\.|slo\\.|diagnosis\\.|brain\\.|preempt\\.)/;
+    const interesting = /^(elastic\\.|master\\.|ckpt\\.restore|rdzv\\.|slo\\.|diagnosis\\.|brain\\.|preempt\\.|serve\\.)/;
     const evs = (rep.timeline || []).filter(
       e => interesting.test(e.kind)).slice(-25);
     document.getElementById('events').textContent = evs.map(e =>
@@ -417,6 +462,9 @@ async function tick() {
       v => (v * 1000).toFixed(1) + ' ms');
     await seriesTable('train.mfu', document.getElementById('mfu'),
       v => (v * 100).toFixed(2) + ' %');
+    await seriesTable('serve.ttft.last_s',
+      document.getElementById('ttft'),
+      v => (v * 1000).toFixed(1) + ' ms');
     document.getElementById('stamp').textContent =
       ' @ ' + new Date().toISOString().slice(11, 19);
     document.getElementById('err').textContent = '';

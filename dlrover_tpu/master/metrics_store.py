@@ -269,18 +269,37 @@ GOODPUT_MIN_RUNTIME_S = float(
 )
 MFU_DROP_RATIO = float(os.environ.get("DLROVER_SLO_MFU_DROP", "0.6"))
 SLO_WINDOW = int(os.environ.get("DLROVER_SLO_WINDOW", "8"))
+# serving SLOs: a TTFT p99 ceiling per decode worker and a sustained
+# request-queue-depth ceiling on the master ledger — the two rules the
+# repair brain's pool-scaling policy listens to
+SERVE_TTFT_P99_S = float(
+    os.environ.get("DLROVER_SLO_SERVE_TTFT", "2.0")
+)
+SERVE_QUEUE_DEPTH_MAX = int(
+    os.environ.get("DLROVER_SLO_SERVE_QUEUE", "16")
+)
+# a TTFT series whose newest point is older than this is a dead/idle
+# worker's leftovers, not a live latency signal: without the guard a
+# chaos-killed worker's frozen breaching series would stand forever
+# and feed the brain an endless scale-out streak
+SERVE_TTFT_STALE_S = float(
+    os.environ.get("DLROVER_SLO_SERVE_TTFT_STALE", "60")
+)
 
 # the gauges the rolling rules watch (emitted by trainer.py every step)
 STEP_GAUGE = "train.step.last_s"
 MFU_GAUGE = "train.mfu"
+# per-worker TTFT gauge the serving scheduler sets on every admission
+SERVE_TTFT_GAUGE = "serve.ttft.last_s"
 
 _median = telemetry.median_baseline
+_quantile = telemetry.nearest_rank_percentile
 
 
 class SloWatchdog:
     """Rolling SLO rules over the metrics store + merged ledger.
 
-    Four rules, each keyed so a breach can clear independently:
+    Six rules, each keyed so a breach can clear independently:
 
     - ``step_time:<source>`` — the rolling median of the newest
       ``window`` step durations exceeds ``ratio`` x the median of the
@@ -294,6 +313,12 @@ class SloWatchdog:
     - ``events_dropped:<source>`` — a source's bounded event ring is
       overwriting its tail on two consecutive sweeps (sustained loss:
       its merged timeline is silently incomplete).
+    - ``serve_ttft:<source>`` — a decode worker's TTFT p99 over its
+      newest ``serve.ttft.last_s`` points exceeds the ceiling (the
+      serving arm's latency SLO).
+    - ``serve_queue`` — the master's decode-request queue depth stayed
+      above its ceiling for the whole window (sustained overload — the
+      repair brain's pool-scaling trigger).
 
     New breaches emit ``slo.breach`` timeline events (master registry,
     so they ride the merged job timeline next to ``diagnosis.*``
@@ -309,6 +334,9 @@ class SloWatchdog:
         goodput_min_runtime_s: float = GOODPUT_MIN_RUNTIME_S,
         mfu_drop_ratio: float = MFU_DROP_RATIO,
         window: int = SLO_WINDOW,
+        serving=None,
+        serve_ttft_p99_s: float = SERVE_TTFT_P99_S,
+        serve_queue_depth_max: int = SERVE_QUEUE_DEPTH_MAX,
     ):
         self._store = store
         self._telemetry = job_telemetry
@@ -317,6 +345,14 @@ class SloWatchdog:
         self._goodput_min_runtime = goodput_min_runtime_s
         self._mfu_drop = mfu_drop_ratio
         self._window = max(window, 2)
+        # the serving request ledger (serving/manager.py); None on a
+        # master without a serving arm — the serve rules just idle
+        self._serving = serving
+        self._serve_ttft_p99 = serve_ttft_p99_s
+        self._serve_queue_max = serve_queue_depth_max
+        # queue-depth samples taken once per check (sustained = every
+        # sample of the newest window above the ceiling)
+        self._queue_hist: deque = deque(maxlen=64)
         self._breaches: dict[str, dict] = {}
         # source -> events_dropped seen on the previous sweep
         self._prev_dropped: dict[str, int] = {}
@@ -384,6 +420,59 @@ class SloWatchdog:
                 "dominant_loss": worst,
             }
 
+    def _check_serve_ttft(self, breaches: dict, now: float):
+        """Per-worker TTFT p99 ceiling over the newest raw points of
+        the ``serve.ttft.last_s`` gauge each decode worker ships.
+        Series gone stale (dead or idle worker) are skipped so their
+        frozen history cannot hold a breach standing forever."""
+        for series in self._store.query(
+            SERVE_TTFT_GAUGE, resolution="raw"
+        ):
+            points = series["points"][-64:]
+            if points and now - points[-1][0] > SERVE_TTFT_STALE_S:
+                continue
+            vals = [v for _t, v in points]
+            if len(vals) < self._window:
+                continue
+            p99 = _quantile(vals, 0.99)
+            if p99 > self._serve_ttft_p99:
+                breaches[f"serve_ttft:{series['source']}"] = {
+                    "rule": "serve_ttft_p99",
+                    "source": series["source"],
+                    "ttft_p99_s": round(p99, 6),
+                    "threshold_s": self._serve_ttft_p99,
+                    "samples": len(vals),
+                }
+
+    def _check_serve_queue(self, breaches: dict):
+        """Sustained decode-queue depth: every sample of the newest
+        window above the ceiling (one submit burst the pool absorbs is
+        not a breach; a queue the pool never drains is)."""
+        serving = self._serving
+        if serving is None:
+            return
+        # drive the ledger's lease-expiry sweep from the master's own
+        # pulse: even with ZERO surviving workers (nobody left to
+        # lease), wedged requests re-queue / fail here instead of
+        # sitting in "leased" forever — and the re-queued depth is
+        # what this rule then prices
+        sweep = getattr(serving, "sweep", None)
+        if sweep is not None:
+            sweep()
+        self._queue_hist.append(int(serving.queue_depth()))
+        w = self._window
+        if len(self._queue_hist) < w:
+            return
+        recent = list(self._queue_hist)[-w:]
+        if min(recent) > self._serve_queue_max:
+            breaches["serve_queue"] = {
+                "rule": "serve_queue_depth",
+                "depth": recent[-1],
+                "min_over_window": min(recent),
+                "threshold": self._serve_queue_max,
+                "window": w,
+            }
+
     def _check_events_dropped(self, breaches: dict):
         current: dict[str, int] = {}
         for snap in self._telemetry.snapshots():
@@ -416,6 +505,8 @@ class SloWatchdog:
         self._check_step_time(breaches)
         self._check_mfu(breaches)
         self._check_goodput(breaches, now)
+        self._check_serve_ttft(breaches, now)
+        self._check_serve_queue(breaches)
         self._check_events_dropped(breaches)
         for key, info in breaches.items():
             if key not in self._breaches:

@@ -477,6 +477,25 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
             return self._rdzv_round, 0, world, coordinator
 
 
+class DecodePoolRendezvousManager(ElasticTrainingRendezvousManager):
+    """The elastic serving arm's node group (``role=decode``): decode
+    workers join the job through the same rendezvous door as trainers,
+    so heartbeat-timeout removal, graceful drain, chaos kills and
+    master-failover state restore all apply to the pool unmodified.
+    The pool's default parameters (min 1, no max, zero wait) form a
+    round per membership change — serving has no collective to
+    synchronize, the round is purely the liveness/membership record
+    the brain and dashboards read."""
+
+    name = RendezvousName.DECODE_POOL
+
+    def __init__(self):
+        super().__init__()
+        self.update_rdzv_params(
+            min_nodes=1, max_nodes=0, waiting_timeout=0.0, node_unit=1
+        )
+
+
 class NetworkCheckRendezvousManager(RendezvousManager):
     """Pairs nodes over successive probe rounds to isolate faults."""
 

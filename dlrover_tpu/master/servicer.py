@@ -145,6 +145,20 @@ class MasterServicer(RpcService):
         self.job_metric_collector = job_metric_collector
         self.elastic_ps_service = elastic_ps_service
         self.ckpt_barrier = CheckpointBarrierService()
+        # elastic serving arm: every master owns the decode-pool node
+        # group (workers join it like trainers join theirs) and the
+        # request ledger fronting the continuous-batching pool
+        if RendezvousName.DECODE_POOL not in self.rdzv_managers:
+            from dlrover_tpu.master.rendezvous import (
+                DecodePoolRendezvousManager,
+            )
+
+            self.rdzv_managers[RendezvousName.DECODE_POOL] = (
+                DecodePoolRendezvousManager()
+            )
+        from dlrover_tpu.serving.manager import ServingRequestManager
+
+        self.serving = ServingRequestManager()
         # job-wide telemetry merge: agents ship registry snapshots
         # (delta-encoded after the first ack), the report query serves
         # the goodput ledger + merged timeline
@@ -185,7 +199,9 @@ class MasterServicer(RpcService):
         self.diagnosis = DiagnosisManager(
             self.telemetry,
             speed_monitor=getattr(task_manager, "speed_monitor", None),
-            slo_watchdog=SloWatchdog(self.metrics_store, self.telemetry),
+            slo_watchdog=SloWatchdog(
+                self.metrics_store, self.telemetry, serving=self.serving
+            ),
             brain=self.brain,
         )
         # durable control-plane state (master failover); set by the
@@ -299,6 +315,21 @@ class MasterServicer(RpcService):
                 hangs=verdicts["hangs"],
                 slo=verdicts.get("slo", {}),
             )
+        if isinstance(message, msg.ServeLeaseRequest):
+            requests, depth = self.serving.lease(
+                message.node_rank, message.max_requests
+            )
+            return msg.ServeLease(requests=requests, queue_depth=depth)
+        if isinstance(message, msg.ServeStatusRequest):
+            return msg.ServeStatus(summary=self.serving.summary())
+        if isinstance(message, msg.ServeFetchRequest):
+            result = self.serving.fetch(message.request_id)
+            return msg.ServeResult(
+                request_id=message.request_id,
+                state=result["state"],
+                tokens=result["tokens"],
+                finish_reason=result["finish_reason"],
+            )
         if isinstance(message, msg.MetricsQueryRequest):
             return msg.MetricsSeries(
                 series=self.metrics_store.query(
@@ -375,8 +406,36 @@ class MasterServicer(RpcService):
                 mgr.drain_node(message.node_rank)
                 self._mark_dirty()
             return True
+        if isinstance(message, msg.ServeSubmitRequest):
+            ok = self.serving.submit({
+                "request_id": message.request_id,
+                "prompt": list(message.prompt),
+                "max_new_tokens": message.max_new_tokens,
+                "temperature": message.temperature,
+                "eos_id": message.eos_id,
+            })
+            if ok:
+                # the ledger rides the master snapshot: an accepted
+                # request must survive a failover, like a dataset shard
+                self._mark_dirty()
+            return ok
+        if isinstance(message, msg.ServeResultReport):
+            ok = self.serving.complete(
+                message.request_id,
+                message.node_rank,
+                message.tokens,
+                finish_reason=message.finish_reason,
+            )
+            if ok:
+                self._mark_dirty()
+            return ok
         if isinstance(message, msg.RdzvParamsReport):
-            for mgr in self.rdzv_managers.values():
+            for name, mgr in self.rdzv_managers.items():
+                if name == RendezvousName.DECODE_POOL:
+                    # the training job's --nnodes elasticity bounds do
+                    # not govern the decode pool: a min_nodes=2 here
+                    # would stop a lone decode worker's round forming
+                    continue
                 mgr.update_rdzv_params(
                     min_nodes=message.min_nodes,
                     max_nodes=message.max_nodes,

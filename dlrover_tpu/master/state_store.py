@@ -238,6 +238,12 @@ class MasterStateStore:
             brain = getattr(self._servicer, "brain", None)
             if brain is not None:
                 state["brain"] = brain.export_state()
+            # the serving request ledger: in-flight decode requests
+            # must outlive a master failover (never-silently-dropped),
+            # like the shard ledger does for training
+            serving = getattr(self._servicer, "serving", None)
+            if serving is not None:
+                state["serving"] = serving.export_state()
         return state
 
     def write_snapshot(self) -> str | None:
@@ -356,6 +362,9 @@ class MasterStateStore:
             brain = getattr(self._servicer, "brain", None)
             if brain is not None and state.get("brain"):
                 brain.restore_state(state["brain"])
+            serving = getattr(self._servicer, "serving", None)
+            if serving is not None and state.get("serving"):
+                serving.restore_state(state["serving"])
 
     def _apply_wal_entry(self, e: dict, snapshot_applied: bool = True):
         op = e.get("op")

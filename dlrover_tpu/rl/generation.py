@@ -34,6 +34,22 @@ from dlrover_tpu.models.llama import (
 
 logger = get_logger(__name__)
 
+# smallest prompt bucket: padding a 3-token prompt to 8 costs noise,
+# while an unbounded set of tiny buckets costs a trace each
+MIN_PROMPT_BUCKET = 8
+
+
+def bucket_len(n: int, cap: int | None = None,
+               min_bucket: int = MIN_PROMPT_BUCKET) -> int:
+    """Next power-of-two >= n, clamped to [min_bucket, cap]. The ONE
+    prompt-bucketing policy, shared by this backend and the serving
+    engine (``serving/engine.py``) so their jit-cache shapes can never
+    drift."""
+    b = min_bucket
+    while b < n:
+        b <<= 1
+    return b if cap is None else min(b, cap)
+
 
 class KVCache(NamedTuple):
     """Ring-buffer cache: ``k``/``v`` are [L, B, C, KVH, hd]; ``pos``
@@ -57,6 +73,41 @@ def init_kv_cache(
         v=jnp.zeros(shape, dtype),
         pos=jnp.full((capacity,), -1, jnp.int32),
     )
+
+
+def moe_mixture(config: LlamaConfig, p, y, dtype):
+    """Per-token top-k expert dispatch for DECODE shapes: no capacity
+    machinery — every token computes its selected experts exactly (the
+    training-path capacity dropping only matters at scale). Gating
+    matches parallel/moe.py:top_k_gating: softmax over all experts,
+    top-k of the probs, renormalised over the selection. All E experts
+    run batched and combine through zero weights — exact at E/top_k x
+    the minimal FFN FLOPs, which is noise at decode (S=1) but real on
+    long-prompt prefill; a gathered dispatch for prefill is a known
+    optimisation left undone. The ONE implementation shared by this
+    backend and the serving engine so their MoE numerics cannot drift.
+    Ref capability: atorch/atorch/rl/inference_backend/ serves MoE
+    policies through vLLM."""
+    E, k = config.n_experts, config.moe_top_k
+    logits = jnp.einsum(
+        "bsd,de->bse", y.astype(jnp.float32),
+        p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)        # [B,S,k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    # [B,S,E] combine weights (0 for unselected experts)
+    weights = jnp.sum(
+        gate_vals[..., None] * jax.nn.one_hot(gate_idx, E), axis=-2
+    ).astype(dtype)
+    # decode shapes are tiny (S=1): run all experts batched and
+    # zero-combine — one einsum chain on the MXU, no gather/scatter
+    gate_h = jax.nn.silu(jnp.einsum(
+        "bsd,edm->bsem", y, p["w_gate"].astype(dtype)))
+    up_h = jnp.einsum("bsd,edm->bsem", y, p["w_up"].astype(dtype))
+    out = jnp.einsum(
+        "bsem,emd->bsed", gate_h * up_h, p["w_down"].astype(dtype))
+    return jnp.einsum("bse,bsed->bsd", weights, out)
 
 
 def _cached_attention(config: LlamaConfig, q, ck, cv, cache_pos, q_pos):
@@ -93,39 +144,6 @@ def _decode_layers(config: LlamaConfig, params, x, positions, cache,
 
     new_pos = cache.pos.at[write_idx].set(positions[0])
 
-    def _moe_mlp(y, p):
-        """Per-token top-k expert dispatch: no capacity machinery —
-        every token computes its selected experts exactly (the
-        training-path capacity dropping only matters at scale).
-        Gating matches parallel/moe.py:top_k_gating: softmax over all
-        experts, top-k of the probs, renormalised over the selection.
-        All E experts run batched and combine through zero weights —
-        exact at E/top_k x the minimal FFN FLOPs, which is noise at
-        decode (S=1) but real on long-prompt prefill; a gathered
-        dispatch for prefill is a known optimisation left undone.
-        Ref capability: atorch/atorch/rl/inference_backend/ serves MoE
-        policies through vLLM."""
-        E, k = config.n_experts, config.moe_top_k
-        logits = jnp.einsum(
-            "bsd,de->bse", y.astype(jnp.float32),
-            p["router"].astype(jnp.float32))
-        probs = jax.nn.softmax(logits, axis=-1)
-        gate_vals, gate_idx = jax.lax.top_k(probs, k)        # [B,S,k]
-        gate_vals = gate_vals / jnp.maximum(
-            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
-        # [B,S,E] combine weights (0 for unselected experts)
-        weights = jnp.sum(
-            gate_vals[..., None] * jax.nn.one_hot(gate_idx, E), axis=-2
-        ).astype(dtype)
-        # decode shapes are tiny (S=1): run all experts batched and
-        # zero-combine — one einsum chain on the MXU, no gather/scatter
-        gate_h = jax.nn.silu(jnp.einsum(
-            "bsd,edm->bsem", y, p["w_gate"].astype(dtype)))
-        up_h = jnp.einsum("bsd,edm->bsem", y, p["w_up"].astype(dtype))
-        out = jnp.einsum(
-            "bsem,emd->bsed", gate_h * up_h, p["w_down"].astype(dtype))
-        return jnp.einsum("bse,bsed->bsd", weights, out)
-
     def layer(carry, xs):
         hdn = carry
         p, ck, cv = xs
@@ -143,7 +161,7 @@ def _decode_layers(config: LlamaConfig, params, x, positions, cache,
         hdn = hdn + attn @ p["wo"].astype(dtype)
         y = _rms_norm(hdn, p["mlp_norm"], config.norm_eps)
         if config.is_moe:
-            hdn = hdn + _moe_mlp(y, p)
+            hdn = hdn + moe_mixture(config, p, y, dtype)
         else:
             gate = jax.nn.silu(y @ p["w_gate"].astype(dtype))
             up = y @ p["w_up"].astype(dtype)
@@ -161,28 +179,49 @@ def _logits(config: LlamaConfig, params, hidden):
     return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
 
 
-def prefill(config: LlamaConfig, params, tokens, cache: KVCache):
+def prefill(config: LlamaConfig, params, tokens, cache: KVCache,
+            valid_len=None):
     """Write the prompt's K/V; returns (last-token logits, cache).
 
     A prompt longer than the cache keeps its last C tokens (true
     sliding-window semantics): writing P > C slots in one scatter would
-    hit duplicate ring indices, whose winner is undefined."""
+    hit duplicate ring indices, whose winner is undefined.
+
+    ``valid_len`` (a TRACED scalar) marks ``tokens`` as a padded
+    length bucket: positions past it get ``-1`` (never attendable),
+    and the returned logits are read at ``valid_len - 1`` instead of
+    the last column — one trace serves every real prompt length inside
+    the bucket. Only supported when the bucket fits the cache (the
+    sliding-window truncation above is a static-shape decision)."""
     dtype = jnp.dtype(config.dtype)
     B, P = tokens.shape
     C = cache.pos.shape[0]
     start = 0
     if P > C:
+        if valid_len is not None:
+            raise ValueError(
+                f"bucketed prefill needs bucket <= cache capacity "
+                f"(got {P} > {C})"
+            )
         start = P - C
         tokens = tokens[:, -C:]
         P = C
-    positions = jnp.broadcast_to(
-        jnp.arange(start, start + P, dtype=jnp.int32), (B, P))
+    pos_row = jnp.arange(start, start + P, dtype=jnp.int32)
+    if valid_len is not None:
+        vl = jnp.asarray(valid_len, jnp.int32)
+        pos_row = jnp.where(pos_row < vl, pos_row, -1)
+    positions = jnp.broadcast_to(pos_row, (B, P))
     x = params["embed"].astype(dtype)[tokens]
     write_idx = jnp.arange(start, start + P, dtype=jnp.int32) % C
     hidden, cache = _decode_layers(
         config, params, x, positions, cache, write_idx
     )
-    return _logits(config, params, hidden[:, -1:, :])[:, 0], cache
+    if valid_len is not None:
+        last = jnp.clip(vl - 1, 0, P - 1)
+        hidden_last = hidden[:, last, :][:, None, :]
+    else:
+        hidden_last = hidden[:, -1:, :]
+    return _logits(config, params, hidden_last)[:, 0], cache
 
 
 def decode_step(config: LlamaConfig, params, token, pos, cache: KVCache):
@@ -222,16 +261,25 @@ def generate(
     prompt_tokens,
     rng,
     gen: GenerateConfig = GenerateConfig(),
+    prompt_len=None,
 ) -> GenerateResult:
     """Jitted autoregressive sampling with the ring-buffer KV cache.
 
     O(T) per new token (vs O(T^2) for re-running the full forward each
-    step — the reference's non-backend path this replaces)."""
+    step — the reference's non-backend path this replaces).
+
+    ``prompt_len`` (a TRACED scalar) marks ``prompt_tokens`` as a
+    padded length bucket: the pads' positions are masked out of the
+    cache and generation starts at ``prompt_len``, so one trace per
+    bucket serves every prompt length inside it (the backend's
+    anti-recompile path)."""
     B, P = prompt_tokens.shape
     N = int(gen.max_new_tokens)
     C = gen.cache_capacity or (P + N)
     cache = init_kv_cache(config, B, C)
-    logits, cache = prefill(config, params, prompt_tokens, cache)
+    logits, cache = prefill(
+        config, params, prompt_tokens, cache, valid_len=prompt_len
+    )
 
     def sample(logits, rng):
         if gen.temperature <= 0:
@@ -252,10 +300,20 @@ def generate(
     tok0, lp0 = sample(logits, sub0)
     alive0 = jnp.ones((B,), jnp.float32)
 
+    # generation starts right after the REAL prompt: at the padded
+    # bucket's valid length when bucketed, at the static width
+    # otherwise
+    gen_start = (
+        jnp.asarray(prompt_len, jnp.int32)
+        if prompt_len is not None else P
+    )
+
     def step(carry, i):
         tok, cache, rng, alive = carry
         rng, sub = jax.random.split(rng)
-        logits, cache = decode_step(config, params, tok, P + i, cache)
+        logits, cache = decode_step(
+            config, params, tok, gen_start + i, cache
+        )
         nxt, lp = sample(logits, sub)
         # emit the newly-sampled token; it is masked out once an eos
         # has been generated at or before the consumed token
@@ -283,15 +341,55 @@ def generate(
 
 class KVCacheGenerationBackend:
     """The reference inference-backend role (vllm_backend.py): hands the
-    PPO loop fast rollouts. Jitted per (batch, prompt-len) shape."""
+    PPO loop fast rollouts.
+
+    Prompts are padded to power-of-two length buckets (masked
+    positions, the real length rides as a TRACED scalar), so the jit
+    cache is keyed by (batch, bucket) instead of (batch, prompt-len) —
+    a PPO loop whose prompt lengths wander no longer retraces prefill
+    on every distinct length. ``bucket_prompts=False`` restores the
+    exact per-length tracing."""
 
     def __init__(self, config: LlamaConfig,
-                 gen: Optional[GenerateConfig] = None):
+                 gen: Optional[GenerateConfig] = None,
+                 bucket_prompts: bool = True):
         self.config = config
         self.gen = gen or GenerateConfig()
+        self.bucket_prompts = bucket_prompts
         self._fn = jax.jit(
             partial(generate, config, gen=self.gen)
         )
 
     def generate(self, params, prompt_tokens, rng) -> GenerateResult:
-        return self._fn(params, jnp.asarray(prompt_tokens), rng)
+        toks = jnp.asarray(prompt_tokens)
+        B, P = toks.shape
+        Pb = bucket_len(P)
+        cap = self.gen.cache_capacity
+        if not self.bucket_prompts or (cap and cap < Pb):
+            # an explicit cache smaller than the bucket means the
+            # sliding-window truncation path — a static-shape decision
+            # the traced-length prefill cannot express
+            return self._fn(params, toks, rng)
+        if Pb == P:
+            padded = toks
+        else:
+            padded = jnp.zeros((B, Pb), toks.dtype).at[:, :P].set(toks)
+        res = self._fn(
+            params, padded, rng, prompt_len=jnp.asarray(P, jnp.int32)
+        )
+        # strip the pad columns: callers see prompt + continuation
+        # exactly as submitted
+        sequences = jnp.concatenate(
+            [toks, res.sequences[:, Pb:]], axis=1
+        )
+        return GenerateResult(
+            sequences=sequences,
+            logprobs=res.logprobs,
+            mask=res.mask,
+        )
+
+    def trace_count(self) -> int:
+        """Compiled generate variants — the bounded-jit-cache
+        assertion tests read this (one per (batch, bucket), never one
+        per prompt length)."""
+        return self._fn._cache_size()

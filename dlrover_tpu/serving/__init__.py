@@ -1,0 +1,54 @@
+"""Elastic inference serving arm: continuous-batching decode under the
+training control plane.
+
+The pieces (see docs/DESIGN.md "Elastic serving"):
+
+- :mod:`engine`    — slotted KV-cache pool + the two jitted programs
+  (bucketed slot prefill, mixed-slot decode step);
+- :mod:`scheduler` — continuous batching over the slot map (admit /
+  decode / evict every step);
+- :mod:`manager`   — master-side request ledger (lease, exactly-once
+  re-queue, never-silently-dropped);
+- :mod:`worker`    — one decode-pool member under the existing master
+  (rendezvous, telemetry shipping, chaos seams);
+- :mod:`loadgen`   — seeded Poisson load + the headline serve_* keys.
+
+Attribute access is lazy: the master imports :mod:`manager` (pure
+stdlib) without dragging the jax-backed engine into a process that
+never decodes.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_EXPORTS = {
+    "DecodeEngine": "engine",
+    "SlotKVCache": "engine",
+    "bucket_len": "engine",
+    "init_slot_cache": "engine",
+    "slot_decode": "engine",
+    "slot_prefill": "engine",
+    "make_requests": "loadgen",
+    "percentile": "loadgen",
+    "poisson_arrivals": "loadgen",
+    "run_open_loop": "loadgen",
+    "summarize": "loadgen",
+    "ServingRequestManager": "manager",
+    "ContinuousBatchingScheduler": "scheduler",
+    "FinishedSequence": "scheduler",
+    "ServeRequest": "scheduler",
+    "DecodeWorker": "worker",
+    "LocalServingClient": "worker",
+    "RpcServingClient": "worker",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(name)
+    mod = importlib.import_module(f"{__name__}.{module}")
+    return getattr(mod, name)

@@ -46,6 +46,13 @@ HEADLINE_KEYS = {
     "goodput_brain_on_pct": "higher",
     "goodput_brain_off_pct": "higher",
     "preempt_notice_saved_s": "higher",
+    # elastic serving arm (tools/chaos_run.py serve-kill sweep):
+    # continuous-batching throughput, TTFT percentiles, and the
+    # fraction of requests served under a chaos-killed decode worker
+    "serve_tokens_per_s": "higher",
+    "serve_ttft_p50_ms": "lower",
+    "serve_ttft_p99_ms": "lower",
+    "serve_goodput_pct": "higher",
 }
 
 

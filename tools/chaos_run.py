@@ -1065,6 +1065,179 @@ def run_week_arm(out_dir: str, arm: str, schedule: dict, cfg: dict) -> dict:
     }
 
 
+def _build_serving_master():
+    """A servicer wired like LocalJobMaster builds it (no socket) —
+    the serving harness drives its dispatch arms in-process."""
+    from dlrover_tpu.common.constants import RendezvousName
+    from dlrover_tpu.master.elastic_ps import ElasticPsService
+    from dlrover_tpu.master.job_manager import LocalJobManager
+    from dlrover_tpu.master.kvstore import KVStoreService, SyncService
+    from dlrover_tpu.master.rendezvous import (
+        ElasticTrainingRendezvousManager,
+        NetworkCheckRendezvousManager,
+    )
+    from dlrover_tpu.master.servicer import MasterServicer
+    from dlrover_tpu.master.shard.task_manager import TaskManager
+
+    task_manager = TaskManager()
+    job_manager = LocalJobManager(None, task_manager.speed_monitor)
+    job_manager.start()
+    rdzv = {
+        RendezvousName.ELASTIC_TRAINING: (
+            ElasticTrainingRendezvousManager()
+        ),
+        RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+    }
+    return MasterServicer(
+        task_manager=task_manager,
+        job_manager=job_manager,
+        rdzv_managers=rdzv,
+        kv_store=KVStoreService(),
+        sync_service=SyncService(),
+        elastic_ps_service=ElasticPsService(),
+    )
+
+
+def _run_serve_kill(schedule: dict, out_dir: str, steps: int) -> int:
+    """The serving-arm availability proof: an in-process master + a
+    3-worker decode pool serving a seeded Poisson sweep with the
+    armed schedule killing one worker mid-sweep. Asserts the ledger's
+    exactly-once contract (everything completes, the victim's leases
+    re-queue exactly once, nothing is dropped or double-served) and
+    publishes the serve_* headline keys bench_diff gates."""
+    import jax
+
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.models import llama_init
+    from dlrover_tpu.models.llama import LlamaConfig
+    from dlrover_tpu.serving import loadgen
+    from dlrover_tpu.serving.engine import DecodeEngine
+    from dlrover_tpu.serving.worker import (
+        DecodeWorker,
+        LocalServingClient,
+    )
+
+    n_workers = 3
+    n_requests = max(int(steps), 4) * 4
+    rate_hz = 60.0
+    config = LlamaConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=128, attn_impl="reference",
+        remat=False, dtype="float32",
+    )
+    params = llama_init(config, jax.random.key(0))
+    servicer = _build_serving_master()
+    # decode steps are milliseconds here: a dead worker's leases must
+    # re-queue fast enough to land inside the sweep
+    servicer.serving._lease_timeout = 2.0
+    servicer.serving._worker_ttl = 3.0
+
+    workers = []
+    for rank in range(n_workers):
+        engine = DecodeEngine(config, params, slots=4, capacity=64)
+        engine.warmup(buckets=[8, 16])
+        workers.append(DecodeWorker(
+            LocalServingClient(servicer, rank), engine, rank,
+            source=f"decode-{rank}-{os.getpid()}",
+        ))
+    for w in workers:
+        w.start()
+
+    requests = loadgen.make_requests(
+        n_requests, config.vocab_size, prompt_len_range=(4, 14),
+        max_new_tokens=8, seed=schedule.get("seed", 41),
+    )
+    arrivals = loadgen.poisson_arrivals(
+        n_requests, rate_hz, seed=schedule.get("seed", 41)
+    )
+
+    def submit(payload: dict) -> bool:
+        return bool(servicer.report(
+            "client", 0, msg.ServeSubmitRequest(**payload)
+        ))
+
+    t0 = time.monotonic()
+    submitted = loadgen.run_open_loop(submit, requests, arrivals)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        counts = servicer.serving.counts()
+        if counts["done"] + counts["failed"] >= submitted:
+            break
+        time.sleep(0.05)
+    wall_s = time.monotonic() - t0
+    for w in workers:
+        w.stop()
+
+    counts = servicer.serving.counts()
+    summary = servicer.serving.summary()
+    finished = [f for w in workers for f in w.finished]
+    keys = loadgen.summarize(submitted, finished, wall_s)
+    keys["serve_goodput_pct"] = round(
+        counts["done"] / submitted * 100.0, 3
+    )
+    result = {
+        "keys": keys,
+        "counts": counts,
+        "summary": summary,
+        "crashed": [w.rank for w in workers if w.crashed],
+        "abandoned": sorted(
+            rid for w in workers for rid in w.abandoned
+        ),
+        "wall_s": round(wall_s, 3),
+    }
+    with open(os.path.join(out_dir, "serve_report.json"), "w") as f:
+        json.dump(result, f, indent=2)
+
+    print("\n=== serve-kill sweep ===")
+    print(f"submitted={submitted}  counts={counts}")
+    print(f"crashed workers: {result['crashed']}  "
+          f"abandoned in flight: {len(result['abandoned'])}")
+    print(f"bench keys: {json.dumps(keys)}")
+
+    failures = []
+    if counts["done"] != submitted:
+        failures.append(
+            f"only {counts['done']}/{submitted} requests completed — "
+            f"something was dropped or wedged"
+        )
+    if counts["failed"]:
+        failures.append(f"{counts['failed']} request(s) marked failed")
+    if not result["crashed"]:
+        failures.append("the schedule never killed a worker")
+    elif not result["abandoned"] and not counts["requeued_total"]:
+        failures.append(
+            "the killed worker had nothing in flight — the sweep "
+            "never exercised the re-queue path"
+        )
+    # exactly-once re-queue: the victim's abandonments all re-queued
+    # (lease expiry may also requeue off a slow-but-alive worker — the
+    # stale-report guard absorbs that), and NO request was ever leased
+    # beyond the cap (original + one re-queue)
+    if counts["requeued_total"] < len(result["abandoned"]):
+        failures.append(
+            f"only {counts['requeued_total']} re-queue(s) for "
+            f"{len(result['abandoned'])} abandoned request(s) — "
+            f"something was silently dropped"
+        )
+    if counts["max_attempts_seen"] > 2:
+        failures.append(
+            f"a request was leased {counts['max_attempts_seen']} "
+            f"times — re-queued more than once"
+        )
+    overlap = max(
+        w.scheduler.stats()["overlap_high_water"] for w in workers
+    )
+    if overlap < 2:
+        failures.append(
+            "no two sequences ever overlapped in one decode step"
+        )
+    for f_ in failures:
+        print(f"FAIL: {f_}")
+    if not failures:
+        print("serve-kill: PASS")
+    return 1 if failures else 0
+
+
 def _run_week(schedule: dict, out_dir: str, steps: int) -> int:
     """The week-in-the-life proof: the SAME seed brain-on and
     brain-off. Announced preemption, hard kill, persistent straggler,
@@ -1230,7 +1403,11 @@ def main() -> int:
     # process stays clean so master/agent control flow is unperturbed
     # unless the schedule targets agent/master sites — then arm locally
     os.environ[chaos.ENV_VAR] = json.dumps(schedule)
-    agent_sites = {"rpc.send", "rpc.recv", "rdzv.join", "agent.spawn"}
+    agent_sites = {
+        "rpc.send", "rpc.recv", "rdzv.join", "agent.spawn",
+        # the serving harness runs master + decode pool in THIS process
+        "serve.step", "serve.admit",
+    }
     if any(r.get("site") in agent_sites for r in schedule.get("rules", [])):
         chaos.install(schedule)
 
@@ -1241,6 +1418,13 @@ def main() -> int:
         # repair-brain harness: in-process master + subprocess hosts,
         # same seed brain-on vs brain-off
         rc = _run_week(schedule, out_dir, args.steps)
+    elif any(
+        str(r.get("site", "")).startswith("serve.")
+        for r in schedule.get("rules", [])
+    ):
+        # serving harness: in-process master + decode pool under a
+        # Poisson sweep, one worker chaos-killed mid-flight
+        rc = _run_serve_kill(schedule, out_dir, args.steps)
     elif any(
         r.get("site") == "master.kill"
         for r in schedule.get("rules", [])

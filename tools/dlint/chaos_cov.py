@@ -3,7 +3,9 @@
 Invariant: every raw I/O seam in the fault-injectable layers
 (``common/``, ``agent/``, ``master/``, ``trainer/``, ``parallel/`` —
 the last pulled into scope by the elastic in-process reshaper, whose
-drain/reshard/resume seams must stay chaos-coverable) is reachable
+drain/reshard/resume seams must stay chaos-coverable — and
+``serving/``, whose admit/lease/report seams the serve-kill schedule
+depends on) is reachable
 through a registered :class:`~dlrover_tpu.common.chaos.ChaosRegistry`
 site — socket ops, write-mode ``open``, and subprocess spawns are
 exactly the places real clusters fail, and PR 2's whole recovery story
@@ -32,7 +34,7 @@ from tools.dlint.astutil import (
 from tools.dlint.core import Finding
 
 _SCOPE_RE = re.compile(
-    r"dlrover_tpu/(common|agent|master|trainer|parallel)/"
+    r"dlrover_tpu/(common|agent|master|trainer|parallel|serving)/"
 )
 _CALLER_HOPS = 2
 

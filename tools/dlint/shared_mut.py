@@ -10,7 +10,13 @@ threads enter the code:
 - ``run()`` of a ``threading.Thread`` subclass;
 - servicer dispatch arms (``get``/``report`` of ``RpcService``
   subclasses — the RPC server runs them thread-per-connection);
-- signal handlers (``signal.signal(sig, fn)``).
+- signal handlers (``signal.signal(sig, fn)``);
+- the serving arm's queue/slot-map components
+  (``dlrover_tpu/serving/{scheduler,manager}.py``): they are entered
+  from the servicer's RPC threads AND the decode worker loop — in
+  *different modules*, which the same-module spawn scan cannot see —
+  so every public method there is a root (``multi``: the RPC side is
+  thread-per-connection).
 
 From each root the checker walks the same-module call graph carrying
 the *held-lock context* (the DL001 region model: ``with`` blocks and
@@ -31,6 +37,7 @@ write line or its enclosing ``def``.
 from __future__ import annotations
 
 import ast
+import re
 
 from tools.dlint.astutil import call_name, dotted, index_for, last_attr
 from tools.dlint.core import Finding
@@ -387,15 +394,44 @@ _ROOT_MARKERS = (
     "Thread", "Timer(", "signal.signal", "RpcService", "Servicer",
 )
 
+# serving queue/slot-map modules: entered concurrently from the RPC
+# dispatch threads (master/servicer.py serve arms) and the decode
+# worker loop — cross-module concurrency the spawn scan cannot see
+_SERVING_ROOT_RE = re.compile(
+    r"dlrover_tpu/serving/(scheduler|manager)\.py$"
+)
+
+
+def _serving_roots(src, index) -> list[_Root]:
+    """Every public method of the serving scheduler/manager classes is
+    a concurrency root (multi=True: the RPC side runs thread-per-
+    connection, and the worker loop is a thread of its own)."""
+    if not _SERVING_ROOT_RE.search(src.relpath.replace("\\", "/")):
+        return []
+    roots = []
+    for qual, info in index.functions.items():
+        if info.class_name is None or "<locals>" in qual:
+            continue
+        method = qual.rsplit(".", 1)[-1]
+        if method.startswith("_"):
+            continue
+        roots.append(_Root(qual, f"serving:{qual}", multi=True))
+    return roots
+
 
 def check_shared_mutation(sources) -> list[Finding]:
     findings = []
     for src, index, ml in _analyze(sources):
         # text pre-filter: most modules have no concurrency roots, and
         # the root scans walk the full tree (tier-1 gate budget)
-        if not any(m in src.text for m in _ROOT_MARKERS):
+        if not any(m in src.text for m in _ROOT_MARKERS) and not \
+                _SERVING_ROOT_RE.search(src.relpath.replace("\\", "/")):
             continue
-        roots = _thread_roots(src, index) + _class_roots(src, index)
+        roots = (
+            _thread_roots(src, index)
+            + _class_roots(src, index)
+            + _serving_roots(src, index)
+        )
         if not roots:
             continue
         aliases = _cond_aliases(src, index, ml)

@@ -52,12 +52,24 @@ KNOWN_SHARED: dict[str, tuple[str, ...]] = {
     "HostArena": ("_free", "_pooled_bytes", "hits", "misses"),
     # agent/ckpt_saver.py — trainer-side save vs agent-side persist
     "AsyncCheckpointSaver": ("_last_persisted_step",),
+    # serving/scheduler.py — the worker loop's admit/evict step racing
+    # request submission (RPC-fed) and the stats/telemetry readers
+    "ContinuousBatchingScheduler": (
+        "_queue", "_slots", "_free", "_steps", "_completed",
+        "_tokens_out", "_overlap_high_water",
+    ),
+    # serving/manager.py — servicer dispatch threads (submit / lease /
+    # complete) racing the lease-expiry sweep and status reads
+    "ServingRequestManager": (
+        "_requests", "_queue", "_workers", "_requeues",
+    ),
 }
 
 # RendezvousManager subclasses share the base field set
 for _sub in (
     "ElasticTrainingRendezvousManager",
     "NetworkCheckRendezvousManager",
+    "DecodePoolRendezvousManager",
 ):
     KNOWN_SHARED[_sub] = KNOWN_SHARED["RendezvousManager"]
 

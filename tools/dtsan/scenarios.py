@@ -353,8 +353,91 @@ def make_ckpt_shm_handoff():
 
 
 # ------------------------------------------------------------------------
-# telemetry: worker registry shipping vs master-side merge
+# serving: scheduler admit/evict racing submit + the telemetry reporter
 # ------------------------------------------------------------------------
+
+
+@_scenario(
+    "serve-slotmap",
+    "serving scheduler: the worker loop's admit/evict step racing "
+    "request submission and the telemetry-snapshot reporter over the "
+    "slot map and the worker registry",
+)
+def make_serve_slotmap():
+    from dlrover_tpu.common import telemetry
+    from dlrover_tpu.master.metrics_store import MetricsStore
+    from dlrover_tpu.serving.scheduler import (
+        ContinuousBatchingScheduler,
+        ServeRequest,
+    )
+
+    class FakeEngine:
+        """Host-only engine stub: the scenario races the SLOT MAP, not
+        the jitted programs (which are single-caller by contract)."""
+
+        slots = 2
+
+        def admit(self, slot, prompt, rng, temperature):
+            return 1, 0.0, len(prompt)
+
+        def step(self, tokens, positions, live, rng, temperature):
+            return [2] * self.slots, [0.0] * self.slots
+
+        def prefill_traces(self):
+            return 1
+
+        def decode_traces(self):
+            return 1
+
+    reg = telemetry.TelemetryRegistry(source="dtsan-decode")
+    sched = ContinuousBatchingScheduler(
+        FakeEngine(), registry=reg, key_factory=lambda: None
+    )
+    job = telemetry.JobTelemetry()
+    store = MetricsStore(raw_maxlen=16)
+    dtsan.shared(sched)
+    dtsan.shared(reg)
+    dtsan.shared(job)
+    dtsan.shared(store)
+    done = []
+
+    def submitter():
+        for i in range(4):
+            sched.submit(ServeRequest(
+                request_id=f"r{i}", prompt=[1, 2, 3],
+                max_new_tokens=2,
+            ))
+
+    def stepper():
+        # the single step() caller (the worker-loop contract); races
+        # submit and the reporter, never another stepper
+        for _ in range(4):
+            done.extend(sched.step())
+
+    def reporter():
+        # the worker's telemetry ship: registry snapshot under live
+        # gauge/counter writes, folded into the master-side merge
+        for _ in range(2):
+            snap = reg.snapshot()
+            assert job.update(snap)
+            store.ingest_snapshot(snap)
+
+    def check():
+        # drain: whatever interleaving ran, finishing the pump must
+        # serve every submitted request exactly once
+        for _ in range(8):
+            done.extend(sched.step())
+        ids = [f.request_id for f in done]
+        assert sorted(ids) == [f"r{i}" for i in range(4)], ids
+        stats = sched.stats()
+        assert stats["completed"] == 4, stats
+        assert stats["queue_depth"] == 0 and stats["live"] == 0, stats
+        # every completion is exactly max_new_tokens long
+        assert all(len(f.tokens) == 2 for f in done), done
+        # the slot map freed everything it admitted
+        assert sorted(sched._free) == [0, 1]
+
+    return [submitter, stepper, reporter], check
 
 
 @_scenario(

@@ -75,6 +75,9 @@ def build_report(
     report["brain"] = _brain_summary(
         report.get("metrics", {}), report.get("timeline", [])
     )
+    report["serving"] = _serving_summary(
+        report.get("metrics", {}), report.get("ledger", {})
+    )
     if trace_dir:
         try:
             from tools.parse_profile import summarize
@@ -189,6 +192,52 @@ def _brain_summary(metrics: dict, timeline: list) -> dict:
     return out
 
 
+def _serving_summary(metrics: dict, ledger: dict) -> dict:
+    """The serving arm at a glance: decode-pool counters/gauges
+    (queue depth, requests by state, per-worker TTFT), merged TTFT
+    percentiles from the ``serve.ttft.seconds`` histograms, and the
+    throughput headline (``serve_tokens_per_s``) — the offline twin of
+    the dashboard's serving panel and the bench sweep's key source."""
+    from dlrover_tpu.common.telemetry import (
+        hist_quantile,
+        sum_bucket_counts,
+    )
+
+    out: dict = {}
+    tokens_total = 0.0
+    for c in metrics.get("counters", ()):
+        if not c["name"].startswith("serve."):
+            continue
+        labels = c.get("labels") or {}
+        label_s = ",".join(
+            f"{k}={v}" for k, v in sorted(labels.items())
+        )
+        out[c["name"] + (f"{{{label_s}}}" if label_s else "")] = (
+            c["value"]
+        )
+        if c["name"] == "serve.tokens":
+            tokens_total += float(c["value"])
+    for g in metrics.get("gauges", ()):
+        if g["name"].startswith(("serve.", "brain.serve.")):
+            out[g["name"]] = g["value"]
+    hists = [
+        h for h in metrics.get("histograms", ())
+        if h["name"] == "serve.ttft.seconds"
+    ]
+    bounds, overall = sum_bucket_counts(hists)
+    if bounds is not None:
+        out["serve_ttft_p50_ms"] = round(
+            hist_quantile(bounds, overall, 0.50) * 1e3, 3
+        )
+        out["serve_ttft_p99_ms"] = round(
+            hist_quantile(bounds, overall, 0.99) * 1e3, 3
+        )
+    total_s = float(ledger.get("total_s") or 0.0)
+    if tokens_total and total_s > 0:
+        out["serve_tokens_per_s"] = round(tokens_total / total_s, 3)
+    return out
+
+
 def _restore_summary(metrics: dict) -> dict:
     """Checkpoint data-path health at a glance: the staged restore
     pipeline's per-leg throughput gauges (read / verify / h2d), the
@@ -250,7 +299,8 @@ _LIVE_EVENT_KINDS = (
     "elastic.reshape", "master.restart", "master.lost", "ckpt.restore",
     "rdzv.join", "rdzv.complete", "slo.breach", "slo.clear",
     "diagnosis.straggler", "diagnosis.hang", "diagnosis.clear",
-    "chaos.fire",
+    "chaos.fire", "serve.request.requeued", "serve.request.failed",
+    "serve.worker.start",
 )
 
 
@@ -274,6 +324,9 @@ def render_live(report: dict, series: dict, slo: dict,
     for name, label, fmt in (
         ("train.step.last_s", "step", lambda v: f"{v * 1e3:8.1f}ms"),
         ("train.mfu", "mfu ", lambda v: f"{v * 100:8.2f}% "),
+        ("serve.ttft.last_s", "ttft",
+         lambda v: f"{v * 1e3:8.1f}ms"),
+        ("serve.queue.depth", "qdep", lambda v: f"{v:8.0f}  "),
     ):
         for s in series.get(name, ()):
             vals = [p[-1] for p in s["points"]]
@@ -319,7 +372,10 @@ def live_loop(master_addr: str, interval: float = 2.0,
             report = client.get_telemetry_report()
             series = {
                 name: client.query_metrics(name, resolution="raw")
-                for name in ("train.step.last_s", "train.mfu")
+                for name in (
+                    "train.step.last_s", "train.mfu",
+                    "serve.ttft.last_s", "serve.queue.depth",
+                )
             }
             slo = dict(client.get_diagnosis().slo or {})
             frame = render_live(report, series, slo)
@@ -433,6 +489,11 @@ def main(argv=None) -> int:
                         f"{p.get('plan_kind', ''):<18}"
                         f"{target:<10} -> {p.get('transition', '')}"
                     )
+        serving = report.get("serving") or {}
+        if serving:
+            print("\n=== serving (decode pool) ===")
+            for name in sorted(serving):
+                print(f"{serving[name]:14.3f}  {name}")
         control = report.get("control_plane") or {}
         if control:
             print("\n=== control plane (master RPC surface) ===")
