@@ -279,6 +279,45 @@ class MasterClient:
                         retries=1)
         return res if res is not None else msg.DiagnosisResult()
 
+    # -------------------------------------------------- deep captures
+
+    def request_capture(
+        self, node_rank: int = -1, steps: int = 0,
+        reason: str = "operator",
+    ) -> msg.ProfileCaptureAck:
+        """Ask the master's CaptureManager for a deep capture of
+        ``node_rank`` (the obs_report --capture front door)."""
+        res = self._get(msg.ProfileCaptureRequest(
+            node_rank=node_rank, steps=steps, reason=reason,
+        ))
+        return res if res is not None else msg.ProfileCaptureAck(
+            reason="no response"
+        )
+
+    def list_captures(self) -> list:
+        res: msg.CaptureList = self._get(msg.CaptureListRequest())
+        return list(res.captures) if res else []
+
+    def report_capture_result(
+        self, capture_id: str, node_rank: int, ok: bool,
+        artifact: str = "", summary: dict | None = None,
+        error: str = "",
+    ) -> bool:
+        """Land a capture outcome on the master ledger (fail-fast:
+        the directive re-serves on the next diagnosis poll if this
+        report is lost)."""
+        return self._report(
+            msg.CaptureResultReport(
+                capture_id=capture_id,
+                node_rank=node_rank,
+                ok=ok,
+                artifact=artifact,
+                summary=dict(summary or {}),
+                error=error,
+            ),
+            retries=2,
+        )
+
     def report_failure(
         self, error_data: str, level: str, restart_count: int = 0
     ) -> bool:

@@ -364,6 +364,12 @@ class ElasticTrainingAgent:
         # per-local-rank agent<->worker reshape channels
         self._last_round = -1
         self._reshape_channels: dict[int, object] = {}
+        # deep-profiling capture channels (agent <-> worker), plus the
+        # one background executor thread — the master's one-in-flight
+        # discipline means at most one capture runs here at a time
+        self._capture_channels: dict[int, object] = {}
+        self._capture_thread = None
+        self._capture_inflight = ""
 
     # ----------------------------------------------------------- lifecycle
 
@@ -475,6 +481,20 @@ class ElasticTrainingAgent:
             channel.clear()
             self._reshape_channels[local_rank] = channel
             env[NodeEnv.RESHAPE_DIR] = rdir
+        # deep-capture channel: the worker's sampler polls it at step
+        # boundaries; the agent relays master capture directives into
+        # it. Per-incarnation like the reshape channel — a fresh
+        # worker must not see a dead incarnation's request/ack.
+        from dlrover_tpu.common import profiling
+
+        cdir = os.path.join(
+            self._config.log_dir or "/tmp/dlrover_tpu/logs",
+            f"capture_{self._config.node_rank}_{local_rank}",
+        )
+        capture_channel = profiling.CaptureChannel(cdir)
+        capture_channel.clear()
+        self._capture_channels[local_rank] = capture_channel
+        env[profiling.ENV_CAPTURE_DIR] = cdir
         restore_step = self._rdzv_handler.last_restore_step
         if restore_step >= 0:
             env[NodeEnv.RESTORE_STEP] = str(restore_step)
@@ -759,11 +779,15 @@ class ElasticTrainingAgent:
         """Best-effort: fetch the master's runtime verdicts; when a
         hang diagnosis names this host, dump the flight recorder once
         per episode so the post-mortem exists even if the stuck worker
-        can never write its own."""
+        can never write its own. The same poll delivers deep-capture
+        directives (``DiagnosisResult.capture``)."""
         try:
             result = self._client.get_diagnosis()
         except Exception:  # noqa: BLE001 - diagnosis is advisory
             return
+        directive = getattr(result, "capture", None) or {}
+        if directive.get("capture_id"):
+            self._maybe_execute_capture(directive)
         hangs = getattr(result, "hangs", None) or {}
         info = hangs.get(self._config.node_rank)
         if info is None:
@@ -777,6 +801,69 @@ class ElasticTrainingAgent:
             rank=self._config.node_rank, **info,
         )
         flight.dump("hang-diagnosis", diagnosis=info)
+
+    # ------------------------------------------------- deep captures
+
+    def _maybe_execute_capture(self, directive: dict):
+        """Run a master capture directive against local worker 0 (one
+        device trace per host is the contract) in a background thread:
+        the capture spans multiple worker steps and must not stall the
+        monitor loop. The directive re-serves on every diagnosis poll
+        while it stands, so the in-flight guard below also absorbs the
+        re-serves."""
+        import threading
+
+        from dlrover_tpu.common import profiling
+
+        cid = str(directive["capture_id"])
+        if self._capture_inflight == cid or (
+            self._capture_thread is not None
+            and self._capture_thread.is_alive()
+        ):
+            return
+        channel = self._capture_channels.get(0)
+        if channel is None:
+            try:
+                self._client.report_capture_result(
+                    cid, self._config.node_rank, False,
+                    error="no worker capture channel",
+                )
+            except (ConnectionError, OSError):
+                pass
+            return
+        self._capture_inflight = cid
+        worker0 = self._workers[0] if self._workers else None
+
+        def report_fn(capture_id, ok, artifact, summary, error):
+            try:
+                self._client.report_capture_result(
+                    capture_id, self._config.node_rank, ok,
+                    artifact=artifact, summary=summary, error=error,
+                )
+            except (ConnectionError, OSError):
+                # the master re-serves the directive on the next poll;
+                # the in-flight marker clears with the thread
+                logger.warning("capture result report failed")
+
+        def run():
+            try:
+                profiling.execute_capture(
+                    directive, channel, report_fn,
+                    alive_fn=(
+                        (lambda: worker0.returncode is None)
+                        if worker0 is not None else None
+                    ),
+                )
+            except Exception:  # noqa: BLE001 - a capture bug must not
+                # take the agent's monitor loop down
+                logger.exception("capture execution failed")
+            finally:
+                self._capture_inflight = ""
+
+        self._capture_thread = threading.Thread(
+            target=run, name="capture-executor", daemon=True
+        )
+        self._capture_thread.start()
 
     # --------------------------------------------- announced preemptions
 

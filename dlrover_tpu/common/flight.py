@@ -83,6 +83,34 @@ def thread_stacks() -> str:
     return "\n".join(chunks)
 
 
+def build_record(snap: dict, reason: str, **extra) -> dict:
+    """The ONE flight-record schema, shared by :func:`dump` and the
+    deep-capture artifact writer (``profiling.write_capture_artifact``)
+    — a field added here (as ``series`` was) lands in both post-mortem
+    surfaces instead of silently diverging."""
+    return {
+        "format": FORMAT,
+        "reason": reason,
+        "time": time.time(),
+        "pid": os.getpid(),
+        "source": snap.get("source") or f"pid-{os.getpid()}",
+        "role": snap.get("role", ""),
+        # the bounded ring IS the flight payload: the last ~4096
+        # spans/events of this process, spans included (kind="span")
+        "events": snap.get("events", []),
+        "events_dropped": snap.get("events_dropped", 0),
+        "counters": snap.get("counters", []),
+        "gauges": snap.get("gauges", []),
+        # the quantitative lead-up, not just the narrative: the
+        # newest ~32 points of every local gauge series (step time,
+        # MFU, HBM, queue depths) so a post-mortem shows the trend
+        # INTO the crash, not only the last value
+        "series": telemetry.series_tail(snap.get("series", [])),
+        "stacks": thread_stacks(),
+        **extra,
+    }
+
+
 def dump(reason: str, _quiet: bool = False, **extra) -> str | None:
     """Write this process's flight record atomically. Returns the path,
     or None when no telemetry dir is configured / the write failed.
@@ -97,23 +125,8 @@ def dump(reason: str, _quiet: bool = False, **extra) -> str | None:
         # thread and may have interrupted a registry hook that holds
         # the (non-reentrant) lock — snapshot() would self-deadlock
         snap = telemetry.snapshot_best_effort() or {}
-        source = snap.get("source") or f"pid-{os.getpid()}"
-        record = {
-            "format": FORMAT,
-            "reason": reason,
-            "time": time.time(),
-            "pid": os.getpid(),
-            "source": source,
-            "role": snap.get("role", ""),
-            # the bounded ring IS the flight payload: the last ~4096
-            # spans/events of this process, spans included (kind="span")
-            "events": snap.get("events", []),
-            "events_dropped": snap.get("events_dropped", 0),
-            "counters": snap.get("counters", []),
-            "gauges": snap.get("gauges", []),
-            "stacks": thread_stacks(),
-            **extra,
-        }
+        record = build_record(snap, reason, **extra)
+        source = record["source"]
         # one artifact per (process, reason): a later dump for the same
         # reason supersedes (atomic replace), different reasons coexist
         safe_reason = "".join(

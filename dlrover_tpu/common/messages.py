@@ -567,6 +567,67 @@ class DiagnosisResult(Message):
     # SLO watchdog breaches: "<rule>:<source>" -> {"rule": ..., ...}
     # (step-time regression, goodput floor, MFU drop, events dropped)
     slo: dict = field(default_factory=dict)
+    # deep-capture directive assigned to the POLLING host (empty when
+    # none): {"capture_id", "steps", "reason"} — delivery rides the
+    # diagnosis poll agents already make every monitor tick, so a
+    # capture needs no extra polling loop
+    capture: dict = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# deep profiling: anomaly-triggered captures
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ProfileCaptureRequest(Message):
+    """Operator/tool-initiated deep capture (``tools/obs_report.py
+    --capture``): ask the master's CaptureManager to direct
+    ``node_rank``'s agent to capture ``steps`` steps of device trace
+    plus the flight-recorder payload. Subject to the same rate-limit
+    and one-in-flight discipline as anomaly-triggered captures."""
+
+    node_rank: int = -1
+    steps: int = 0
+    reason: str = "operator"
+
+
+@dataclass
+class ProfileCaptureAck(Message):
+    """The admission verdict: refusals carry WHY (cooldown, another
+    capture in flight, manager disabled)."""
+
+    capture_id: str = ""
+    accepted: bool = False
+    reason: str = ""
+
+
+@dataclass
+class CaptureListRequest(Message):
+    pass
+
+
+@dataclass
+class CaptureList(Message):
+    """The capture ledger (newest first): state machine position,
+    artifact path, and the parsed summary incl. the attribution diff
+    vs the stored op-cost baseline."""
+
+    captures: list = field(default_factory=list)
+
+
+@dataclass
+class CaptureResultReport(Message):
+    """The executing agent's outcome report. Exactly-once on the
+    master: only the assigned host's first report lands; duplicates
+    are acknowledged-and-dropped."""
+
+    capture_id: str = ""
+    node_rank: int = -1
+    ok: bool = False
+    artifact: str = ""
+    summary: dict = field(default_factory=dict)
+    error: str = ""
 
 
 # --------------------------------------------------------------------------

@@ -154,6 +154,29 @@ def nearest_rank_percentile(values, q: float) -> float:
     return float(ordered[k])
 
 
+# how many trailing points of each gauge series ride a flight-recorder
+# or capture artifact: the quantitative lead-up to a crash/anomaly
+# (step-time, MFU, HBM trend), without shipping whole rings
+SERIES_TAIL_POINTS = 32
+
+
+def series_tail(series_list, n: int = SERIES_TAIL_POINTS) -> list:
+    """Trim a snapshot's ``series`` section to the newest ``n`` points
+    per series. One definition shared by the flight recorder and the
+    deep-capture artifact writer so post-mortems carry the same
+    quantitative tail everywhere."""
+    out = []
+    for s in series_list or ():
+        points = list(s.get("points") or ())[-n:]
+        if points:
+            out.append({
+                "name": s.get("name"),
+                "labels": dict(s.get("labels") or {}),
+                "points": points,
+            })
+    return out
+
+
 def sum_bucket_counts(hists):
     """Element-wise sum of le-bucket histogram series (snapshot-dict
     shape: ``{"bounds": [...], "counts": [...]}``). The first series'

@@ -115,6 +115,7 @@ class DiagnosisManager:
         check_interval: float = CHECK_INTERVAL,
         slo_watchdog=None,
         brain=None,
+        capture=None,
     ):
         self._telemetry = job_telemetry
         self._speed_monitor = speed_monitor
@@ -126,6 +127,10 @@ class DiagnosisManager:
         # fresh verdicts feed its policies AFTER the manager's lock is
         # released (its actuators call into other components)
         self.brain = brain
+        # the deep-capture manager (master/capture.py) rides it too:
+        # a breach/straggler verdict becomes a capture directive for
+        # the blamed host, rate-limited by the manager itself
+        self.capture = capture
         self._ratio = ratio
         self._zscore = zscore
         self._hang_factor = hang_factor
@@ -404,6 +409,14 @@ class DiagnosisManager:
             except Exception:  # noqa: BLE001 - a policy bug must not
                 # take straggler/hang detection down with it
                 logger.exception("brain sweep failed")
+        capture = self.capture
+        if capture is not None:
+            try:
+                capture.on_sweep(result, now)
+            except Exception:  # noqa: BLE001 - same contract as the
+                # brain: a capture-trigger bug must not take
+                # straggler/hang detection down with it
+                logger.exception("capture sweep failed")
         return result
 
     def stragglers(self) -> dict[int, dict]:

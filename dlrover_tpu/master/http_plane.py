@@ -171,6 +171,14 @@ def render_prometheus(servicer) -> str:
                 w.get("served", 0),
                 "requests served per decode worker", "gauge",
             )
+    capture = getattr(servicer, "capture", None)
+    if capture is not None:
+        s = capture.summary()
+        for state, n in sorted((s.get("states") or {}).items()):
+            sample(
+                "dlrtpu_prof_captures", {"state": str(state)}, n,
+                "deep-capture ledger records by state", "gauge",
+            )
     brain = getattr(servicer, "brain", None)
     if brain is not None:
         s = brain.summary()
@@ -225,7 +233,27 @@ class MasterHttpPlane:
         report["serving"] = (
             serving.summary() if serving is not None else {}
         )
+        capture = getattr(self._servicer, "capture", None)
+        report["captures"] = (
+            capture.summary() if capture is not None else {}
+        )
         return report
+
+    def captures_payload(self, query: dict) -> dict:
+        """The deep-capture ledger: every record (newest first) with
+        its artifact path and attribution diff; ``?id=`` narrows to
+        one record (the "download" of its full summary payload)."""
+        capture = getattr(self._servicer, "capture", None)
+        if capture is None:
+            return {"captures": []}
+        records = capture.list()
+        want = (query.get("id") or [""])[0]
+        if want:
+            records = [r for r in records if r["id"] == want]
+        return {
+            "captures": records,
+            **capture.summary(),
+        }
 
     def series_payload(self, query: dict) -> dict:
         name = (query.get("name") or [""])[0]
@@ -281,6 +309,14 @@ class MasterHttpPlane:
                         self._send(
                             200,
                             json.dumps(plane.series_payload(
+                                parse_qs(parsed.query)
+                            )).encode(),
+                            "application/json",
+                        )
+                    elif path == "/captures.json":
+                        self._send(
+                            200,
+                            json.dumps(plane.captures_payload(
                                 parse_qs(parsed.query)
                             )).encode(),
                             "application/json",
@@ -357,6 +393,8 @@ DASHBOARD_HTML = """<!doctype html>
 <h2>serving (decode pool)</h2><pre id="serving">no serving arm</pre>
 <h2>serving TTFT (serve.ttft.last_s, per worker)</h2>
 <div id="ttft"></div>
+<h2>deep captures (device-time profiling)</h2>
+<pre id="captures">none</pre>
 <h2>brain (repair plans)</h2><pre id="brain">none</pre>
 <h2>recent events (reshape / restart / ckpt / slo / diagnosis / brain)</h2>
 <pre id="events"></pre>
@@ -432,6 +470,20 @@ async function tick() {
         '\\n' + Object.entries(serving.workers || {}).map(
           ([rank, w]) => 'worker ' + rank + ': served=' + w.served +
             ' idle=' + w.idle_s + 's').join('\\n');
+    }
+    const capR = await fetch('/captures.json');
+    const caps = (await capR.json()).captures || [];
+    const cEl = document.getElementById('captures');
+    if (caps.length) {
+      cEl.textContent = caps.slice(0, 8).map(c => {
+        const attr = ((c.summary || {}).attribution || [])[0];
+        const diff = attr && attr.delta_pct != null
+          ? '  ' + attr.category + ' ' +
+            (attr.delta_pct > 0 ? '+' : '') + attr.delta_pct +
+            '% vs baseline' : '';
+        return c.id + '  host=' + c.rank + '  [' + c.state + ']  ' +
+          c.reason + diff;
+      }).join('\\n');
     }
     const brain = rep.brain || {};
     const plans = brain.recent || [];

@@ -188,12 +188,23 @@ class MasterServicer(RpcService):
             wal_fn=lambda op, **fields: self._wal(op, **fields),
             dirty_fn=self._mark_dirty,
         )
+        # deep-profiling capture plane: SLO breaches, straggler
+        # verdicts and operator requests become bounded capture
+        # directives to the blamed host's agent, exactly-once across
+        # failover (WAL + snapshot, like brain plans)
+        from dlrover_tpu.master.capture import CaptureManager
+
+        self.capture = CaptureManager(
+            wal_fn=lambda op, **fields: self._wal(op, **fields),
+            dirty_fn=self._mark_dirty,
+        )
         # runtime straggler/hang diagnosis over the merged telemetry
         # (per-host TimerRing phase gauges + step.end activity); checks
         # are pull-driven from heartbeats and diagnosis queries. The
         # SLO watchdog rides the same rate-limited sweep so breaches
-        # surface next to straggler/hang verdicts — and the brain
-        # rides it too, turning fresh verdicts into ScalePlans.
+        # surface next to straggler/hang verdicts — and the brain and
+        # capture manager ride it too, turning fresh verdicts into
+        # ScalePlans and deep-capture directives.
         from dlrover_tpu.master.diagnosis import DiagnosisManager
 
         self.diagnosis = DiagnosisManager(
@@ -203,6 +214,7 @@ class MasterServicer(RpcService):
                 self.metrics_store, self.telemetry, serving=self.serving
             ),
             brain=self.brain,
+            capture=self.capture,
         )
         # durable control-plane state (master failover); set by the
         # owning JobMaster when a state dir is configured
@@ -314,7 +326,17 @@ class MasterServicer(RpcService):
                 stragglers=verdicts["stragglers"],
                 hangs=verdicts["hangs"],
                 slo=verdicts.get("slo", {}),
+                # the polling host's pending deep-capture directive
+                # (idempotent re-serve while it stands)
+                capture=self.capture.poll_directive(message.node_rank),
             )
+        if isinstance(message, msg.ProfileCaptureRequest):
+            return msg.ProfileCaptureAck(**self.capture.request(
+                message.node_rank, steps=message.steps,
+                reason=message.reason,
+            ))
+        if isinstance(message, msg.CaptureListRequest):
+            return msg.CaptureList(captures=self.capture.list())
         if isinstance(message, msg.ServeLeaseRequest):
             requests, depth = self.serving.lease(
                 message.node_rank, message.max_requests
@@ -620,6 +642,12 @@ class MasterServicer(RpcService):
                 self.metrics_store.ingest_snapshot(message.payload)
                 self._mark_dirty()
             return ok
+        if isinstance(message, msg.CaptureResultReport):
+            return self.capture.report_result(
+                message.capture_id, message.node_rank, message.ok,
+                artifact=message.artifact, summary=message.summary,
+                error=message.error,
+            )
         if isinstance(message, msg.DiagnosisReport):
             logger.info(
                 "diagnosis from %s-%s [%s]: %s",

@@ -244,6 +244,12 @@ class MasterStateStore:
             serving = getattr(self._servicer, "serving", None)
             if serving is not None:
                 state["serving"] = serving.export_state()
+            # deep-capture ledger: a directive decided (or served)
+            # before a failover must be re-served IDENTICALLY by the
+            # restored master, never re-decided or double-executed
+            capture = getattr(self._servicer, "capture", None)
+            if capture is not None:
+                state["captures"] = capture.export_state()
         return state
 
     def write_snapshot(self) -> str | None:
@@ -365,6 +371,9 @@ class MasterStateStore:
             serving = getattr(self._servicer, "serving", None)
             if serving is not None and state.get("serving"):
                 serving.restore_state(state["serving"])
+            capture = getattr(self._servicer, "capture", None)
+            if capture is not None and state.get("captures"):
+                capture.restore_state(state["captures"])
 
     def _apply_wal_entry(self, e: dict, snapshot_applied: bool = True):
         op = e.get("op")
@@ -401,6 +410,13 @@ class MasterStateStore:
                 # over-replaying the tail around a snapshot boundary
                 # is a no-op and the id counter only moves forward
                 brain.replay_plan(e["plan"], seq=e.get("brain_seq"))
+        elif op == "capture" and self._servicer is not None:
+            capture = getattr(self._servicer, "capture", None)
+            if capture is not None:
+                # absolute record state: upsert replay by capture id,
+                # id counter monotonic — over-replaying the tail
+                # around a snapshot boundary is a no-op
+                capture.replay(e["record"], next_id=e.get("next_id"))
         elif op == "kv" and self._kv_store is not None:
             self._kv_store.set(
                 e["key"], base64.b64decode(e["value"])

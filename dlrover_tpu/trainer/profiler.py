@@ -174,57 +174,17 @@ class StepProfiler:
 
 def top_ops_from_trace(log_dir: str, k: int = 15,
                        steps: int = 1) -> list[dict]:
-    """Parse the newest XPlane trace under ``log_dir`` into the top-k
-    HLO ops by total self time.
+    """Top-k HLO ops of the newest XPlane trace under ``log_dir`` by
+    total self time per step: ``[{op, category, self_ms_per_step}]``.
 
-    The online half of xpu_timer's per-kernel attribution (reference
-    atorch/dev/xpu_timer/xpu_timer/common/manager.cc exports named
-    kernel histograms over brpc/Prometheus): the offline
-    tools/parse_profile.py logic, packaged so the agent can surface
-    per-op timings on its /metrics endpoint between checkpoint windows.
-    Returns [{"op", "category", "self_ms_per_step"}] (divided by
-    ``steps``, the number of profiled steps in the window).
-    """
-    import glob
-    import json as _json
+    The online half of xpu_timer's per-kernel attribution — a thin
+    delegate to the ONE shared trace walker
+    (:mod:`dlrover_tpu.common.trace_summary`), which the offline CLI
+    and the deep-profiling sampler also consume, so an xprof layout
+    drift breaks in one place."""
+    from dlrover_tpu.common import trace_summary
 
-    paths = sorted(glob.glob(
-        os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True))
-    if not paths:
-        return []
-    try:
-        from xprof.convert import raw_to_tool_data as rtd
-
-        data, _ = rtd.xspace_to_tool_data([paths[-1]], "hlo_stats", {})
-        if isinstance(data, bytes):
-            data = data.decode()
-        obj = _json.loads(data)
-    except Exception:  # noqa: BLE001 - xprof optional / format drift
-        # (some xprof versions emit CSV here, not gviz JSON)
-        logger.warning("xprof unavailable; no per-op stats", exc_info=True)
-        return []
-    cols = [c["label"] for c in obj["cols"]]
-    try:
-        icat = cols.index("HLO op category")
-        iname = cols.index("HLO op name")
-        itime = cols.index("Total self time (us)")
-    except ValueError:
-        return []
-    agg: dict = {}
-    for row in obj["rows"]:
-        vals = [c["v"] for c in row["c"]]
-        t = float(vals[itime] or 0)
-        key = (str(vals[icat]), str(vals[iname]))
-        agg[key] = agg.get(key, 0.0) + t
-    top = sorted(agg.items(), key=lambda kv: -kv[1])[:k]
-    return [
-        {
-            "op": name,
-            "category": cat,
-            "self_ms_per_step": round(t / max(steps, 1) / 1e3, 4),
-        }
-        for (cat, name), t in top
-    ]
+    return trace_summary.top_ops(log_dir, k=k, steps=steps)
 
 
 def publish_kernel_stats(log_dir: str, k: int = 15, steps: int = 1,

@@ -221,6 +221,19 @@ class Trainer:
                 # online per-kernel attribution, not just trace files
                 publish_top_ops=True,
             )
+        # always-on device-time accounting + deep-capture execution
+        # (common/profiling.py): one sampled step every
+        # DLROVER_PROF_SAMPLE_STEPS becomes device.optime_ms gauges +
+        # the persisted op-cost baseline; the agent's capture channel
+        # (DLROVER_PROF_CAPTURE_DIR) is polled at every step boundary.
+        # Self-disabling where no parse toolchain exists — the hooks
+        # then cost one branch per step.
+        from dlrover_tpu.common import profiling
+
+        self._prof = profiling.DeviceTimeSampler(
+            os.path.join(args.output_dir, "prof"),
+        )
+        self._refresh_prof_context()
 
     # -------------------------------------------------------------- resume
 
@@ -387,6 +400,7 @@ class Trainer:
                         self._timer.record(Tag.DATA_WAIT, t_wait, wait_ns)
                     if self._profiler is not None:
                         self._profiler.maybe_start(self.global_step)
+                    self._prof.on_step_start(self.global_step)
                     t0 = time.time_ns()
                     with tracing.span(
                         "train.step", step=self.global_step + 1
@@ -410,6 +424,11 @@ class Trainer:
                     if self._timer is not None:
                         self._timer.record(Tag.STEP, t0, dur_ns)
                     dur_s = dur_ns / 1e9
+                    # the step number the window opened at (pre-
+                    # increment); a finished window parses off-thread
+                    self._prof.on_step_end(
+                        self.global_step - 1, dur_s, block_on=metrics
+                    )
                     steady = self._compiled_once
                     if steady:
                         telemetry.event(
@@ -592,6 +611,22 @@ class Trainer:
         # a reshape's re-jit is a cache replay, and the gauge pair
         # shows whether the persistent cache is actually being reused
         self._emit_compile_cache_gauges()
+
+    def _refresh_prof_context(self):
+        """The op-cost baseline key (model fingerprint + mesh shape),
+        computed once per (re)shape — a reshaped mesh gets its OWN
+        baseline row, so a legitimate topology change never reads as
+        an op-cost regression."""
+        from dlrover_tpu.common import profiling
+
+        try:
+            self._prof.set_context(
+                profiling.model_fingerprint(self.state.params),
+                profiling.mesh_shape_key(self._accel.mesh),
+            )
+        except Exception:  # noqa: BLE001 - a non-standard state tree
+            # only loses baseline keying, never the training loop
+            self._prof.set_context("unfingerprinted", "devices=?")
 
     def _emit_compile_cache_gauges(self):
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
@@ -975,6 +1010,8 @@ class Trainer:
         self._compiled_once = False
         # model FLOPs are a per-(re)shape constant, not a per-step one
         self._refresh_flops()
+        # ...and so is the op-cost baseline key (new mesh shape)
+        self._refresh_prof_context()
 
     def _reshape_data(self, req):
         """Exactly-once dataset re-accounting: re-shard the epoch
@@ -1263,5 +1300,6 @@ class Trainer:
     def close(self):
         if self._profiler is not None:
             self._profiler.close()
+        self._prof.close()
         if self._engine is not None:
             self._engine.close()

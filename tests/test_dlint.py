@@ -380,6 +380,52 @@ class TestSignalSafety:
             signal.signal(signal.SIGTERM, _handler)
         """, "signal-safety") == []
 
+    def test_profiler_trace_in_handler_flagged(self, tmp_path):
+        """Capture-trigger scope: starting/stopping jax.profiler
+        within handler reach (here: one hop) is a DL004 finding."""
+        found = lint_file(tmp_path, """
+            import signal
+            import jax
+
+            def _handler(signum, frame):
+                _emergency_profile()
+
+            def _emergency_profile():
+                jax.profiler.start_trace("/tmp/t")
+                jax.profiler.stop_trace()
+
+            signal.signal(signal.SIGTERM, _handler)
+        """, "signal-safety")
+        kinds = {f.message.split(" in ")[0] for f in found}
+        assert "profiler start_trace call" in kinds
+        assert "profiler stop_trace call" in kinds
+
+    def test_capture_artifact_write_in_handler_flagged(self, tmp_path):
+        found = lint_file(tmp_path, """
+            import signal
+            from dlrover_tpu.common import profiling
+
+            def _handler(signum, frame):
+                profiling.write_capture_artifact("/tmp/a", {}, {})
+
+            signal.signal(signal.SIGTERM, _handler)
+        """, "signal-safety")
+        assert len(found) == 1
+        assert "capture-artifact write" in found[0].message
+
+    def test_profiler_outside_handler_clean(self, tmp_path):
+        """The same calls OUTSIDE signal reach are fine — the sampler's
+        step-boundary path must not need an allow hatch."""
+        assert lint_file(tmp_path, """
+            import jax
+            from dlrover_tpu.common import profiling
+
+            def sample_window(out_dir, summary, snap):
+                jax.profiler.start_trace(out_dir)
+                jax.profiler.stop_trace()
+                profiling.write_capture_artifact(out_dir, summary, snap)
+        """, "signal-safety") == []
+
 
 # ---------------------------------------------------------------- DL005
 

@@ -485,7 +485,30 @@ class TestHttpPlane:
             f"http://127.0.0.1:{plane.port}/metrics", timeout=10
         ) as resp:
             assert resp.headers["Content-Type"].startswith("text/plain")
-            samples = parse_prometheus(resp.read().decode())
+            text = resp.read().decode()
+            samples = parse_prometheus(text)
+        # every emitted family is announced with # HELP and # TYPE
+        # lines BEFORE its first sample (the exposition-format
+        # contract scrapers rely on)
+        announced_help, announced_type = set(), set()
+        for line in text.splitlines():
+            if line.startswith("# HELP "):
+                announced_help.add(line.split()[2])
+            elif line.startswith("# TYPE "):
+                parts = line.split()
+                announced_type.add(parts[2])
+                assert parts[3] in ("counter", "gauge", "histogram")
+            elif line.strip():
+                family = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", line)
+                base = family.group(0)
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if base.endswith(suffix) and (
+                        base[: -len(suffix)] in announced_type
+                    ):
+                        base = base[: -len(suffix)]
+                        break
+                assert base in announced_help, f"no # HELP for {line!r}"
+                assert base in announced_type, f"no # TYPE for {line!r}"
         assert ('{source="worker-0-42"}', 0.4) in samples[
             "dlrtpu_train_mfu"
         ]

@@ -53,6 +53,11 @@ HEADLINE_KEYS = {
     "serve_ttft_p50_ms": "lower",
     "serve_ttft_p99_ms": "lower",
     "serve_goodput_pct": "higher",
+    # deep-profiling plane (bench._profiling_bench): steady-state
+    # always-on sampler cost (the <2% contract) and the operator
+    # request -> parsed-artifact deep-capture round trip
+    "profile_sample_overhead_pct": "lower",
+    "capture_roundtrip_s": "lower",
 }
 
 

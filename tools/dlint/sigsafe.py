@@ -17,6 +17,12 @@ registered via ``signal.signal(...)``:
 - ``telemetry.snapshot`` (the PR-6 bug: use ``snapshot_best_effort``,
   which bounds its lock acquire, from crash paths)
 - ``time.sleep`` (stretches the async window; a handler must finish)
+- the deep-profiling capture path: ``jax.profiler``
+  ``start_trace``/``stop_trace`` (runtime-lock-taking, potentially
+  blocking on device work) and capture-artifact writers
+  (``write_capture_artifact`` / ``.ack``-carrying capture channels go
+  through ``telemetry.snapshot`` + multi-file I/O) — crash paths keep
+  ``flight.dump``, which is built to run there
 
 Guarded calls (e.g. logging behind an ``if not _quiet:`` that the
 signal path sets) carry ``# dlint: allow-signal(reason)``.
@@ -163,6 +169,22 @@ def check_signal_safety(sources) -> list[Finding]:
                     emit(
                         node.lineno, "sleep",
                         "a handler must finish, not linger",
+                    )
+                elif tail in ("start_trace", "stop_trace") and (
+                    "profiler" in recv
+                ):
+                    emit(
+                        node.lineno, f"profiler {tail} call",
+                        "starting/stopping a device trace takes "
+                        "runtime locks and can block on device work; "
+                        "never drive jax.profiler from signal context",
+                    )
+                elif tail == "write_capture_artifact":
+                    emit(
+                        node.lineno, "capture-artifact write",
+                        "artifact writers snapshot the (lock-taking) "
+                        "telemetry registry and do multi-file I/O; "
+                        "crash paths keep flight.dump",
                     )
                 elif tail == "acquire" and not _bounded_acquire(node):
                     if _lockish_recv(recv):

@@ -78,6 +78,9 @@ def build_report(
     report["serving"] = _serving_summary(
         report.get("metrics", {}), report.get("ledger", {})
     )
+    report["profiling"] = _profiling_summary(
+        report.get("metrics", {}), report.get("timeline", [])
+    )
     if trace_dir:
         try:
             from tools.parse_profile import summarize
@@ -238,6 +241,46 @@ def _serving_summary(metrics: dict, ledger: dict) -> dict:
     return out
 
 
+def _profiling_summary(metrics: dict, timeline: list) -> dict:
+    """The deep-profiling plane at a glance: per-category device time
+    from the always-on sampler (``device.optime_ms{category=...}``),
+    sample/capture counters, and the recent ``device.optime.
+    regression`` / ``prof.capture.*`` event tail — the offline twin of
+    the dashboard's captures panel."""
+    out: dict = {}
+    for g in metrics.get("gauges", ()):
+        if not g["name"].startswith("device.optime"):
+            continue
+        cat = (g.get("labels") or {}).get("category")
+        key = g["name"] + (f"{{category={cat}}}" if cat else "")
+        out[key] = g["value"]
+    for c in metrics.get("counters", ()):
+        if c["name"].startswith("prof."):
+            labels = c.get("labels") or {}
+            label_s = ",".join(
+                f"{k}={v}" for k, v in sorted(labels.items())
+            )
+            out[c["name"] + (f"{{{label_s}}}" if label_s else "")] = (
+                c["value"]
+            )
+    events = [
+        {
+            "t": ev.get("t"),
+            "kind": ev.get("kind"),
+            "capture": ev.get("capture"),
+            "category": ev.get("category"),
+            "delta_pct": ev.get("delta_pct"),
+        }
+        for ev in timeline
+        if str(ev.get("kind", "")).startswith(
+            ("device.optime.regression", "prof.capture.")
+        )
+    ][-16:]
+    if not out and not events:
+        return {}
+    return {"metrics": out, "events": events}
+
+
 def _restore_summary(metrics: dict) -> dict:
     """Checkpoint data-path health at a glance: the staged restore
     pipeline's per-leg throughput gauges (read / verify / h2d), the
@@ -273,6 +316,87 @@ def warn_events_dropped(report: dict, out=None) -> bool:
         print(f"!!   {source}: {n} event(s) lost", file=out)
     print("!" * 66, file=out)
     return True
+
+
+# -------------------------------------------------------- capture trigger
+
+
+def run_capture(
+    master_addr: str, node_rank: int, steps: int = 0,
+    wait: float = 120.0, out=None, poll: float = 1.0,
+) -> int:
+    """Operator front door of the deep-capture plane: ask the master's
+    CaptureManager to profile ``node_rank``, then poll the ledger until
+    the artifact lands (or the wait expires). Prints the record incl.
+    the attribution diff vs the stored op-cost baseline."""
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    out = sys.stdout if out is None else out
+    client = MasterClient(master_addr, 0, "tool")
+    try:
+        ack = client.request_capture(
+            node_rank, steps=steps, reason="operator:obs_report"
+        )
+        if not ack.accepted:
+            print(f"capture refused: {ack.reason}", file=sys.stderr)
+            return 1
+        cid = ack.capture_id
+        print(f"capture {cid} accepted for host {node_rank}; "
+              f"waiting for the artifact...", file=out)
+        deadline = time.time() + wait
+        rec = None
+        while time.time() < deadline:
+            rec = next(
+                (r for r in client.list_captures() if r["id"] == cid),
+                None,
+            )
+            if rec is not None and rec["state"] in ("done", "failed"):
+                break
+            time.sleep(poll)
+        if rec is None or rec["state"] not in ("done", "failed"):
+            print(f"capture {cid} still "
+                  f"{rec['state'] if rec else 'unknown'} after "
+                  f"{wait:.0f}s", file=sys.stderr)
+            return 1
+        print(json.dumps(rec, indent=2), file=out)
+        if rec["state"] != "done":
+            return 1
+        attribution = (rec.get("summary") or {}).get("attribution") or []
+        for a in attribution[:5]:
+            delta = a.get("delta_pct")
+            print(
+                f"  {a['category']:<20} {a['current_ms']:9.3f} ms/step"
+                f"  vs baseline {a['baseline_ms']:9.3f}"
+                + (f"  ({delta:+.1f}%)" if delta is not None else
+                   "  (new)"),
+                file=out,
+            )
+        return 0
+    finally:
+        client.close()
+
+
+def write_perfetto(report: dict, out_path: str,
+                   trace_dir: str | None = None) -> str:
+    """Merge the report's host timeline (span forest included) with
+    the device side — the ``--trace-dir`` XPlane capture when given —
+    into one Perfetto/Chrome-trace JSON file."""
+    from dlrover_tpu.common import profiling
+
+    device_categories = None
+    device_trace = None
+    if trace_dir:
+        device_trace = profiling.device_trace_from_xplane(trace_dir)
+        profile = report.get("profile") or {}
+        device_categories = profile.get("by_canonical_category")
+    merged = profiling.merge_perfetto(
+        report.get("timeline", []),
+        device_categories=device_categories,
+        device_trace_events=device_trace,
+    )
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return out_path
 
 
 # ---------------------------------------------------------------- live mode
@@ -422,6 +546,25 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--json", action="store_true")
     parser.add_argument(
+        "--capture", type=int, default=None, metavar="RANK",
+        help="trigger a deep capture of host RANK on a live master "
+        "(--master) and wait for the artifact + attribution diff",
+    )
+    parser.add_argument(
+        "--capture-steps", type=int, default=0,
+        help="steps of device trace for --capture (0 = master default)",
+    )
+    parser.add_argument(
+        "--capture-wait", type=float, default=120.0,
+        help="seconds to wait for the --capture artifact",
+    )
+    parser.add_argument(
+        "--perfetto", metavar="OUT.json",
+        help="write the merged host+device Perfetto/Chrome-trace "
+        "timeline (host spans from --dir/--master; device side from "
+        "--trace-dir when given)",
+    )
+    parser.add_argument(
         "--live", action="store_true",
         help="poll a running master (--master) and redraw a compact "
         "live view with text sparklines from its metrics store",
@@ -433,6 +576,13 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if not args.telemetry_dir and not args.master_addr:
         parser.error("need --dir and/or --master")
+    if args.capture is not None:
+        if not args.master_addr:
+            parser.error("--capture needs --master (a running job)")
+        return run_capture(
+            args.master_addr, args.capture, steps=args.capture_steps,
+            wait=args.capture_wait,
+        )
     if args.live:
         if not args.master_addr:
             parser.error("--live needs --master (a running job)")
@@ -448,6 +598,12 @@ def main(argv=None) -> int:
         print("no telemetry snapshots found", file=sys.stderr)
         return 1
     warn_events_dropped(report)
+    if args.perfetto:
+        path = write_perfetto(
+            report, args.perfetto, trace_dir=args.trace_dir,
+        )
+        print(f"merged Perfetto timeline written to {path}",
+              file=sys.stderr)
     if args.json:
         print(json.dumps(report, indent=2))
     elif args.trace:
@@ -494,6 +650,17 @@ def main(argv=None) -> int:
             print("\n=== serving (decode pool) ===")
             for name in sorted(serving):
                 print(f"{serving[name]:14.3f}  {name}")
+        profiling = report.get("profiling") or {}
+        if profiling:
+            print("\n=== deep profiling (device-time accounting) ===")
+            for name in sorted(profiling.get("metrics", {})):
+                print(f"{profiling['metrics'][name]:14.3f}  {name}")
+            for ev in profiling.get("events") or []:
+                extra = " ".join(
+                    f"{k}={v}" for k, v in ev.items()
+                    if k not in ("t", "kind") and v is not None
+                )
+                print(f"  {ev['kind']:<28} {extra}")
         control = report.get("control_plane") or {}
         if control:
             print("\n=== control plane (master RPC surface) ===")

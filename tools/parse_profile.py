@@ -4,96 +4,34 @@ Usage:
     python tools/parse_profile.py /path/to/trace_dir --steps 3
     python tools/parse_profile.py /path/to/trace_dir --steps 3 --json
 
-The summary is importable (``summarize``) so ``tools/obs_report.py`` can
-embed the per-category step breakdown next to the goodput ledger when a
-trace exists.
+A thin CLI over the ONE shared trace walker
+(``dlrover_tpu/common/trace_summary.py``), which the deep-profiling
+sampler and ``trainer/profiler.py`` consume too. ``summarize`` stays
+importable from here (``tools/obs_report.py`` embeds the per-category
+step breakdown next to the goodput ledger when a trace exists).
+
+Exit codes: 0 parsed, 1 no traces under the directory, 2 the xprof
+toolchain is unavailable or the trace would not parse — always a clear
+one-line message, never a stack trace.
 """
 
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
-def summarize(trace_dir: str, steps: int = 1, top: int = 45) -> dict | None:
-    """Per-category/per-op self-time summary of every ``*.xplane.pb``
-    under ``trace_dir``. Returns None when no trace files exist.
-    Raises ImportError when the xprof toolchain is unavailable —
-    callers that merely *embed* the summary should catch it."""
-    paths = glob.glob(
-        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
-    )
-    if not paths:
-        return None
-    from xprof.convert import raw_to_tool_data as rtd
+from dlrover_tpu.common.trace_summary import (  # noqa: E402
+    render,
+    summarize,
+)
 
-    data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
-    if isinstance(data, bytes):
-        data = data.decode()
-    obj = json.loads(data)
-    cols = [c["label"] for c in obj["cols"]]
-    rows = [[c["v"] for c in r["c"]] for r in obj["rows"]]
-    icat = cols.index("HLO op category")
-    iname = cols.index("HLO op name")
-    itime = cols.index("Total self time (us)")
-    iocc = cols.index("#Occurrences")
-
-    steps = max(int(steps), 1)
-    bycat: dict[str, float] = {}
-    byop: dict[tuple, list] = {}
-    for r in rows:
-        t = float(r[itime] or 0)
-        bycat[r[icat]] = bycat.get(r[icat], 0.0) + t
-        byop.setdefault((r[icat], r[iname]), [0.0, 0])
-        byop[(r[icat], r[iname])][0] += t
-        byop[(r[icat], r[iname])][1] += int(r[iocc] or 0)
-
-    tot = sum(bycat.values())
-    return {
-        "trace_dir": trace_dir,
-        "steps": steps,
-        "num_traces": len(paths),
-        "total_ms_per_step": tot / steps / 1e3,
-        "by_category": {
-            cat: t / steps / 1e3 for cat, t in bycat.items()
-        },
-        "top_ops": [
-            {
-                "category": cat,
-                "op": name,
-                "ms_per_step": t / steps / 1e3,
-                "occurrences": occ,
-            }
-            for (cat, name), (t, occ) in sorted(
-                byop.items(), key=lambda kv: -kv[1][0]
-            )[:top]
-        ],
-    }
-
-
-def render(summary: dict) -> str:
-    lines = [
-        f"total self time {summary['total_ms_per_step']:.1f} ms/step "
-        f"({summary['num_traces']} trace file(s), "
-        f"{summary['steps']} step(s))",
-        "",
-        "=== by category ===",
-    ]
-    for cat, ms in sorted(
-        summary["by_category"].items(), key=lambda kv: -kv[1]
-    ):
-        lines.append(f"{ms:8.2f} ms/step  {cat}")
-    lines.append("")
-    lines.append(f"=== top {len(summary['top_ops'])} ops ===")
-    for op in summary["top_ops"]:
-        lines.append(
-            f"{op['ms_per_step']:8.3f} ms/step  x{op['occurrences']:4d} "
-            f"{op['category']:22s} {op['op'][:80]}"
-        )
-    return "\n".join(lines)
+__all__ = ["summarize", "render", "main"]
 
 
 def main(argv=None) -> int:
@@ -111,10 +49,24 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="emit the summary as JSON"
     )
     args = parser.parse_args(argv)
+    if not os.path.isdir(args.trace_dir):
+        print(
+            f"trace dir does not exist: {args.trace_dir}",
+            file=sys.stderr,
+        )
+        return 1
     try:
         summary = summarize(args.trace_dir, steps=args.steps, top=args.top)
     except ImportError as e:
         print(f"xprof toolchain unavailable: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # noqa: BLE001 - CLI contract: a clear
+        # message for a broken/drifted trace, never a stack trace
+        print(
+            f"could not parse trace under {args.trace_dir}: "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
         return 2
     if summary is None:
         print(f"no *.xplane.pb traces under {args.trace_dir}",

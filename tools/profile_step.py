@@ -1,32 +1,57 @@
-"""Profile the nano-350m train step; print top HLO ops by self time."""
-import dataclasses
-import glob
+"""Profile the nano-350m train step; print top HLO ops by self time.
+
+Usage:
+    python tools/profile_step.py [flash|ring|naive] [--steps N]
+
+Captures an XPlane trace of N steady-state steps and renders it through
+the ONE shared trace walker (``dlrover_tpu/common/trace_summary.py``) —
+the same summarizer the offline CLI (``parse_profile.py``) and the
+always-on sampler use, so this tool can never drift from them.
+"""
+
+from __future__ import annotations
+
+import argparse
 import os
+import shutil
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
-def main():
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("impl", nargs="?", default="flash",
+                        help="attention impl (flash|ring|naive)")
+    parser.add_argument("--steps", type=int, default=3)
+    parser.add_argument(
+        "--trace-dir", default="/tmp/dlrover_tpu/profile_step",
+    )
+    args = parser.parse_args(argv)
+
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
+    from dlrover_tpu.common.trace_summary import render, summarize
     from dlrover_tpu.models import (
         PRESETS, llama_init, llama_logical_axes, llama_loss_fn,
     )
     from dlrover_tpu.parallel import MeshConfig, Strategy, auto_accelerate
+    from dlrover_tpu.trainer.profiler import trace
 
-    impl = sys.argv[1] if len(sys.argv) > 1 else "flash"
     config = dataclasses.replace(
-        PRESETS["nano-350m"], attn_impl=impl,
+        PRESETS["nano-350m"], attn_impl=args.impl,
         attn_block_q=1024, attn_block_k=1024)
     batch, seq = 8, 2048
 
     strategy = Strategy(mesh=MeshConfig(data=1, fsdp=1),
-                        compute_dtype="bfloat16", remat="none", donate=True)
+                       compute_dtype="bfloat16", remat="none", donate=True)
     res = auto_accelerate(
         llama_loss_fn(config), lambda rng: llama_init(config, rng),
         optax.adafactor(1e-3), llama_logical_axes(config),
@@ -34,60 +59,38 @@ def main():
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, config.vocab_size, (batch, seq + 1)))
     state = res.state
+    # warmup/compile outside the profiled window
     state, m = res.train_step(state, {"tokens": tokens}, jax.random.key(0))
     _ = float(m["loss"])
 
-    tdir = "/root/repo/_profile_out"
-    import shutil
-    shutil.rmtree(tdir, ignore_errors=True)
-    with jax.profiler.trace(tdir):
-        for i in range(3):
+    shutil.rmtree(args.trace_dir, ignore_errors=True)
+    with trace(args.trace_dir):
+        for i in range(args.steps):
             state, m = res.train_step(
                 state, {"tokens": tokens}, jax.random.key(i))
         _ = float(m["loss"])
 
-    time.sleep(2)
-    paths = glob.glob(tdir + "/**/*.xplane.pb", recursive=True)
-    print("xplane files:", paths)
-    from xprof.convert import raw_to_tool_data as rtd
-
-    data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
-    import csv
-    import io
-    if isinstance(data, bytes):
-        data = data.decode()
-    rows = list(csv.reader(io.StringIO(data)))
-    hdr = rows[0]
-    print(hdr)
-    icat = hdr.index("HLO category") if "HLO category" in hdr else None
-    iname = 2
-    for c in ("total_self_time_us", "Total self time (us)", "self_time_us"):
-        if c in hdr:
-            itime = hdr.index(c)
-            break
-    else:
-        itime = None
-        for idx, c in enumerate(hdr):
-            if "self" in c.lower() and "us" in c.lower():
-                itime = idx
-    agg = {}
-    for r in rows[1:]:
-        if not r or itime is None:
-            continue
-        try:
-            t = float(r[itime])
-        except (ValueError, IndexError):
-            continue
-        cat = r[icat] if icat is not None else "?"
-        name = r[iname][:70] if len(r) > iname else "?"
-        agg.setdefault((cat, name), 0.0)
-        agg[(cat, name)] += t
-    top = sorted(agg.items(), key=lambda kv: -kv[1])[:40]
-    tot = sum(agg.values())
-    print(f"total self time: {tot/1e3:.1f} ms over 3 steps")
-    for (cat, name), t in top:
-        print(f"{t/3/1e3:8.3f} ms/step  {cat:24s} {name}")
+    try:
+        summary = summarize(args.trace_dir, steps=args.steps)
+    except ImportError as e:
+        print(f"xprof toolchain unavailable: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # noqa: BLE001 - same CLI contract as
+        # parse_profile: xprof layout drift (e.g. CSV-emitting
+        # versions) gets a clear message, never a stack trace
+        print(
+            f"could not parse trace under {args.trace_dir}: "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 2
+    if summary is None:
+        print(f"no trace captured under {args.trace_dir}",
+              file=sys.stderr)
+        return 1
+    print(render(summary))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
