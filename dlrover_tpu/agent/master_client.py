@@ -188,6 +188,7 @@ class MasterClient:
     def join_rendezvous(
         self, node_rank: int, local_world_size: int, rdzv_name: str,
         verified_ckpt_step: int = -1, verified_ckpt_steps=None,
+        probe_report=None,
     ) -> bool:
         return self._report(
             msg.JoinRendezvousRequest(
@@ -198,6 +199,9 @@ class MasterClient:
                 node_ip=self._host_ip,
                 verified_ckpt_step=verified_ckpt_step,
                 verified_ckpt_steps=list(verified_ckpt_steps or ()),
+                # the hardware probe's per-leg timings; empty = no
+                # probe ran, the master's gate admits (old behavior)
+                probe_report=dict(probe_report or {}),
             )
         )
 
@@ -268,6 +272,25 @@ class MasterClient:
 
     def check_network_ready(self) -> msg.NetworkCheckResult:
         return self._get(msg.NetworkReadyRequest())
+
+    def get_node_health(self, node_rank: int) -> msg.NodeHealthVerdict:
+        """This host's standing health-gate verdict — polled while a
+        join has been acked but no world forms, to tell "round still
+        filling" apart from "parked in quarantine"."""
+        res = self._get(
+            msg.NodeHealthRequest(node_rank=node_rank), retries=1
+        )
+        return res if res is not None else msg.NodeHealthVerdict()
+
+    def report_probe(self, node_rank: int, report: dict) -> bool:
+        """Ship an in-band re-probe report to the fingerprint store.
+        Best-effort: a dropped sample just waits for the next window."""
+        return self._report(
+            msg.HostProbeReport(
+                node_rank=node_rank, report=dict(report or {})
+            ),
+            retries=1,
+        )
 
     def check_straggler(self) -> msg.NetworkCheckResult:
         return self._get(msg.StragglerExistRequest())

@@ -159,12 +159,22 @@ class MasterRendezvousHandler:
         local_world_size: int,
         timeout: float,
         verified_step_fn=None,
+        probe_scheduler=None,
     ):
         self._name = name
         self._node_rank = node_rank
         self._client = client
         self._local_world_size = local_world_size
         self._timeout = timeout
+        # hardware-probe cadence cache (agent/probe.py): joins ship the
+        # freshest per-leg timings; the process-wide default means a
+        # net-check round and the training join share one probe
+        from dlrover_tpu.agent.probe import default_scheduler
+
+        self._probe = (
+            probe_scheduler if probe_scheduler is not None
+            else default_scheduler()
+        )
         # callable -> list of locally-restorable checkpoint steps,
         # reported at join for the master's restore consensus (the
         # master forces only a step common to EVERY member)
@@ -198,14 +208,30 @@ class MasterRendezvousHandler:
         ):
             return self._next_rendezvous()
 
+    def _probe_report(self, fresh: bool = False) -> dict:
+        """The hardware probe report to ship with a join: the cached
+        sample while it is fresh, a re-run when the gate demanded one
+        (``fresh``) or nothing is cached yet. Empty when disabled."""
+        from dlrover_tpu.agent import probe as hw_probe
+
+        if hw_probe.probe_disabled():
+            return {}
+        if fresh or self._probe.last_report is None:
+            return self._probe.run(self._node_rank)
+        return self._probe.last_report
+
     def _next_rendezvous(self):
         t0 = time.monotonic()
         verified_steps = self._local_verified_steps()
         newest = verified_steps[0] if verified_steps else -1
+        # probe BEFORE the join: the master's health gate judges these
+        # per-leg timings against the fleet and this host's own history
+        probe_report = self._probe_report()
         joined = self._client.join_rendezvous(
             self._node_rank, self._local_world_size, self._name,
             verified_ckpt_step=newest,
             verified_ckpt_steps=verified_steps,
+            probe_report=probe_report,
         )
         start = time.time()
         while True:
@@ -218,10 +244,50 @@ class MasterRendezvousHandler:
                     self._node_rank, self._local_world_size, self._name,
                     verified_ckpt_step=newest,
                     verified_ckpt_steps=verified_steps,
+                    probe_report=probe_report,
                 )
             world = self._client.get_comm_world(self._name, self._node_rank)
             if world and world.world and self._node_rank in world.world:
                 break
+            # an acked join with no world forming is EITHER a round
+            # still filling or this host parked at the health gate —
+            # only the verdict poll can tell them apart
+            verdict = self._client.get_node_health(self._node_rank)
+            if verdict.verdict in ("quarantine", "refuse"):
+                remaining = start + self._timeout - time.time()
+                wait = max(min(verdict.retry_after_s, remaining), 1.0)
+                if remaining <= wait:
+                    raise TimeoutError(
+                        f"rendezvous {self._name}: host "
+                        f"{self._node_rank} {verdict.verdict}d by the "
+                        f"health gate ({verdict.reason}) and the "
+                        f"backoff outlives the {self._timeout}s window"
+                    )
+                logger.warning(
+                    "health gate %sd this host (%s); re-probing in "
+                    "%.0fs (strike %d)",
+                    verdict.verdict, verdict.reason, wait,
+                    verdict.strikes,
+                )
+                telemetry.event(
+                    "probe." + verdict.verdict,
+                    rank=self._node_rank,
+                    reason=verdict.reason,
+                    retry_after_s=wait,
+                    strikes=verdict.strikes,
+                )
+                # wait out the backoff, then re-join with a FRESH
+                # probe — the gate re-serves the standing verdict to
+                # anything staler
+                time.sleep(wait)
+                probe_report = self._probe_report(fresh=True)
+                joined = self._client.join_rendezvous(
+                    self._node_rank, self._local_world_size, self._name,
+                    verified_ckpt_step=newest,
+                    verified_ckpt_steps=verified_steps,
+                    probe_report=probe_report,
+                )
+                continue
             if time.time() - start > self._timeout:
                 raise TimeoutError(
                     f"rendezvous {self._name} timed out after "
@@ -750,6 +816,12 @@ class ElasticTrainingAgent:
             # triggers a local flight-recorder dump (the worker's own
             # detector may be the thing that's stuck)
             self._poll_diagnosis()
+            # continuous hardware check: a governed low-cadence
+            # re-probe (floor interval stretched until the probe costs
+            # under its overhead budget) feeding the master's
+            # fingerprint store — sustained degradation becomes a
+            # hw_degraded verdict and a drain, not a mystery slowdown
+            self._maybe_reprobe()
             # announced preemption: the platform (simulated by the
             # ``preempt.notice`` chaos action) says this host dies at a
             # deadline — relay to the brain and, when directed, drain
@@ -774,6 +846,23 @@ class ElasticTrainingAgent:
             if self._heartbeat.action == "restart":
                 self._heartbeat.action = ""
                 self._restart_workers()
+
+    def _maybe_reprobe(self):
+        """In-band hardware re-probe on the shared scheduler's cadence;
+        best-effort shipping to the master's fingerprint store."""
+        from dlrover_tpu.agent import probe as hw_probe
+
+        if hw_probe.probe_disabled():
+            return
+        sched = hw_probe.default_scheduler()
+        if not sched.due():
+            return
+        report = sched.run(self._config.node_rank)
+        try:
+            self._client.report_probe(self._config.node_rank, report)
+        except Exception:  # noqa: BLE001 - the health signal is
+            # advisory; a dropped sample waits for the next window
+            logger.warning("in-band probe report failed", exc_info=True)
 
     def _poll_diagnosis(self):
         """Best-effort: fetch the master's runtime verdicts; when a

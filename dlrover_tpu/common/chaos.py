@@ -9,7 +9,7 @@ sites** threaded through the control plane (``rpc.send``, ``rpc.recv``,
 ``ipc.request``, ``agent.spawn``, ``ckpt.write``, ``ckpt.manifest``,
 ``ckpt.save``, ``rdzv.join``, ``master.kill``, ``elastic.signal``,
 ``elastic.reshape``, ``preempt.notice``, ``brain.plan``,
-``serve.admit``, ``serve.step``) consult a
+``serve.admit``, ``serve.step``, ``probe.degrade``) consult a
 seeded schedule
 that can drop or
 delay RPC frames, kill or hang a process at a chosen step, tear a
@@ -44,6 +44,11 @@ Rule fields (all optional except ``site`` and ``action``)::
 
     site:   fault-site name, e.g. "rpc.send"
     action: drop | disconnect | delay | hang | kill | error | notice
+            | degrade                  (degrade: hardware-degradation
+            sites, e.g. "probe.degrade" inside the health probe's
+            timed legs — sleeps ``delay`` seconds scaled by a seeded
+            per-rule jitter, so a rank-anchored rule makes exactly
+            that host's measured timings look slow)
             | tear | bitflip           (tear/bitflip: transform sites)
     prob:   fire probability per matching call (default 1.0, seeded)
     step:   only fire when the site reports this training step
@@ -102,7 +107,7 @@ class ChaosRule:
 
     _CONTROL_ACTIONS = (
         "drop", "disconnect", "delay", "hang", "kill", "error",
-        "notice",
+        "notice", "degrade",
     )
     _TRANSFORM_ACTIONS = ("tear", "bitflip")
 
@@ -191,6 +196,13 @@ class ChaosRule:
             )
         if self.action in ("delay", "hang"):
             time.sleep(self.delay)
+            return
+        if self.action == "degrade":
+            # scaled perturbation, not a fixed stall: the sleep jitters
+            # around ``delay`` via the rule's own RNG, so a degraded
+            # host's probe legs look *noisily* slow (like real thermal
+            # or HBM trouble) while the fire pattern stays replayable
+            time.sleep(self.delay * (0.75 + 0.5 * self._rng.random()))
             return
         if self.action == "kill":
             logger.warning(
@@ -659,6 +671,41 @@ NAMED_SCHEDULES: dict[str, dict] = {
                 "verb": "serving",
                 "after": 3,
                 "max": 1,
+            },
+        ],
+    },
+    # a degraded host meets the health gate: host 3 joins with a
+    # chaos-inflated probe (every leg's timed window eats a seeded
+    # ~0.4 s degrade sleep) and must be quarantined at the door —
+    # never entering a round; host 1 joins clean, then its in-band
+    # re-probes run degraded, so the fingerprint regression becomes a
+    # diagnosis.hw_degraded verdict and the brain drains it with zero
+    # survivor restarts. ``max: 6`` bounds host 3's affliction to two
+    # probes (3 legs each): its backoff re-probe comes back clean and
+    # the gate re-admits it. Driven by tools/chaos_run.py
+    # ``_run_bad_host``, which publishes probe_join_overhead_s /
+    # bad_host_quarantine_s (gated by tools/bench_diff.py).
+    "bad-host": {
+        "desc": "degrade host 3's join probe (quarantined at the door, "
+        "re-admitted after its backoff re-probe comes back clean) and "
+        "host 1's in-band re-probes (hw_degraded verdict -> brain "
+        "drain+reshape, zero survivor restarts); publishes "
+        "probe_join_overhead_s / bad_host_quarantine_s",
+        "seed": 37,
+        "rules": [
+            {
+                "site": "probe.degrade",
+                "action": "degrade",
+                "rank": 3,
+                "delay": 0.4,
+                "max": 6,
+            },
+            {
+                "site": "probe.degrade",
+                "action": "degrade",
+                "rank": 1,
+                "delay": 0.4,
+                "after": 3,
             },
         ],
     },

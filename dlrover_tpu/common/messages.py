@@ -116,6 +116,12 @@ class JoinRendezvousRequest(Message):
     # that host silently restores something older and the world splits.
     verified_ckpt_step: int = -1
     verified_ckpt_steps: list = field(default_factory=list)
+    # join-time hardware probe (agent/probe.py run_probe): per-leg
+    # millisecond timings the master's health gate judges against the
+    # fleet median and this host's own persisted fingerprint before
+    # admission. Empty = no probe ran (old agents, probe disabled):
+    # the gate admits, preserving the pre-health-plane behavior.
+    probe_report: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -247,6 +253,40 @@ class NetworkCheckResult(Message):
 @dataclass
 class StragglerExistRequest(Message):
     pass
+
+
+@dataclass
+class HostProbeReport(Message):
+    """In-band hardware re-probe result (agent monitor loop, governed
+    cadence): the same per-leg report shipped at join, folded into the
+    master's per-host fingerprint store so a sustained degradation
+    becomes a ``diagnosis.hw_degraded`` verdict mid-run."""
+
+    node_rank: int = 0
+    report: dict = field(default_factory=dict)
+
+
+@dataclass
+class NodeHealthRequest(Message):
+    """Query the health gate's standing verdict for one host — polled
+    by an agent whose join did not land in a round, to tell a filling
+    round apart from its own quarantine (and learn the re-probe
+    backoff)."""
+
+    node_rank: int = 0
+
+
+@dataclass
+class NodeHealthVerdict(Message):
+    """The gate's answer: ``verdict`` is "pass" | "quarantine" |
+    "refuse" | "unknown" (never probed). ``retry_after_s`` is the
+    remaining backoff before a quarantined host's re-probe will be
+    considered."""
+
+    verdict: str = "unknown"
+    reason: str = ""
+    retry_after_s: float = 0.0
+    strikes: int = 0
 
 
 @dataclass
@@ -572,6 +612,9 @@ class DiagnosisResult(Message):
     # diagnosis poll agents already make every monitor tick, so a
     # capture needs no extra polling loop
     capture: dict = field(default_factory=dict)
+    # sustained hardware degradation (health-plane fingerprints):
+    # node_rank -> {"leg": worst leg, "ratio": vs own baseline, ...}
+    hw: dict = field(default_factory=dict)
 
 
 # --------------------------------------------------------------------------

@@ -323,11 +323,19 @@ class RepairBrain:
             rank = _source_rank(info.get("source", ""))
             if rank is not None:
                 named.add(rank)
+        # hardware-degradation verdicts (health plane probe timings)
+        # were ALREADY debounced by the health manager's own
+        # persistence streak before they surface here, so they enter
+        # at eviction strength instead of re-serving the sweeps the
+        # probe already counted
+        hw_named = {int(r) for r in (verdicts.get("hw") or {})}
+        named |= hw_named
         with self._lock:
             for rank in named:
-                self._suspect_streak[rank] = (
-                    self._suspect_streak.get(rank, 0) + 1
-                )
+                streak = self._suspect_streak.get(rank, 0) + 1
+                if rank in hw_named:
+                    streak = max(streak, self._persist_sweeps)
+                self._suspect_streak[rank] = streak
             for rank in list(self._suspect_streak):
                 if rank not in named:
                     del self._suspect_streak[rank]

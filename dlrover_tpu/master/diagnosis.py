@@ -116,6 +116,7 @@ class DiagnosisManager:
         slo_watchdog=None,
         brain=None,
         capture=None,
+        health=None,
     ):
         self._telemetry = job_telemetry
         self._speed_monitor = speed_monitor
@@ -131,6 +132,10 @@ class DiagnosisManager:
         # a breach/straggler verdict becomes a capture directive for
         # the blamed host, rate-limited by the manager itself
         self.capture = capture
+        # the hardware health plane (master/health.py) surfaces its
+        # sustained in-band degradations through this sweep: they
+        # become ``hw`` verdicts the brain drains like stragglers
+        self.health = health
         self._ratio = ratio
         self._zscore = zscore
         self._hang_factor = hang_factor
@@ -142,6 +147,8 @@ class DiagnosisManager:
         self._stragglers: dict[int, dict] = {}
         # rank -> {"stalled_s": float, "last_step": int, ...}
         self._hangs: dict[int, dict] = {}
+        # rank -> {"leg": str, "ratio": float, "streak": int, ...}
+        self._hw: dict[int, dict] = {}
 
     # ------------------------------------------------------------ inputs
 
@@ -357,6 +364,7 @@ class DiagnosisManager:
                         self.slo.breaches() if self.slo is not None
                         else {}
                     ),
+                    "hw": dict(self._hw),
                 }
             self._last_check = now
             snaps = self._telemetry.snapshots()
@@ -383,6 +391,23 @@ class DiagnosisManager:
                         "hang diagnosed: rank %s %s", rank, info
                     )
                     telemetry.event("diagnosis.hang", rank=rank, **info)
+            hw = {}
+            if self.health is not None:
+                try:
+                    hw = self.health.hw_degraded()
+                except Exception:  # noqa: BLE001 - same contract as
+                    # the watchdog: a health-plane bug must not take
+                    # straggler/hang detection down with it
+                    logger.exception("health sweep failed")
+            for rank, info in hw.items():
+                if rank not in self._hw:
+                    logger.error(
+                        "hardware degradation diagnosed: rank %s %s",
+                        rank, info,
+                    )
+                    telemetry.event(
+                        "diagnosis.hw_degraded", rank=rank, **info
+                    )
             for rank in set(self._stragglers) - set(stragglers):
                 telemetry.event(
                     "diagnosis.clear", rank=rank, what="straggler"
@@ -391,12 +416,18 @@ class DiagnosisManager:
                 telemetry.event(
                     "diagnosis.clear", rank=rank, what="hang"
                 )
+            for rank in set(self._hw) - set(hw):
+                telemetry.event(
+                    "diagnosis.clear", rank=rank, what="hw"
+                )
             self._stragglers = stragglers
             self._hangs = hangs
+            self._hw = hw
             result = {
                 "stragglers": dict(stragglers),
                 "hangs": dict(hangs),
                 "slo": slo,
+                "hw": dict(hw),
             }
         # the brain runs OUTSIDE the manager lock: its policies call
         # into other components (rendezvous drain, run configs, WAL),

@@ -225,6 +225,7 @@ class MasterHttpPlane:
         report["diagnosis"] = {
             "stragglers": verdicts.get("stragglers", {}),
             "hangs": verdicts.get("hangs", {}),
+            "hw": verdicts.get("hw", {}),
         }
         report["slo"] = verdicts.get("slo", {})
         brain = getattr(self._servicer, "brain", None)
@@ -236,6 +237,13 @@ class MasterHttpPlane:
         capture = getattr(self._servicer, "capture", None)
         report["captures"] = (
             capture.summary() if capture is not None else {}
+        )
+        # per-host hardware health: fingerprint EWMAs + recent leg
+        # history (the dashboard's sparkline source), standing
+        # gate verdicts, quarantine set
+        health = getattr(self._servicer, "health", None)
+        report["health"] = (
+            health.summary() if health is not None else {}
         )
         return report
 
@@ -395,6 +403,7 @@ DASHBOARD_HTML = """<!doctype html>
 <div id="ttft"></div>
 <h2>deep captures (device-time profiling)</h2>
 <pre id="captures">none</pre>
+<h2>host health (probe fingerprints)</h2><div id="health">none</div>
 <h2>brain (repair plans)</h2><pre id="brain">none</pre>
 <h2>recent events (reshape / restart / ckpt / slo / diagnosis / brain)</h2>
 <pre id="events"></pre>
@@ -484,6 +493,28 @@ async function tick() {
         return c.id + '  host=' + c.rank + '  [' + c.state + ']  ' +
           c.reason + diff;
       }).join('\\n');
+    }
+    const health = rep.health || {};
+    const hEl = document.getElementById('health');
+    const hosts = Object.entries(health.hosts || {});
+    if (hosts.length) {
+      const t = document.createElement('table');
+      hosts.forEach(([rank, h]) => {
+        const row = t.insertRow();
+        const bad = h.verdict !== 'pass';
+        const cell = row.insertCell();
+        cell.textContent = 'host ' + rank + '  [' + h.verdict + ']' +
+          (bad ? '  ' + h.reason : '') +
+          (h.degraded_streak ? '  streak=' + h.degraded_streak : '');
+        cell.className = bad ? 'bad' : 'ok';
+        for (const [leg, ms] of Object.entries(h.legs || {})) {
+          const lc = row.insertCell();
+          lc.textContent = leg + ' ' + ms.toFixed(1) + 'ms';
+          lc.appendChild(spark(
+            (h.history[leg] || []).map(v => [v])));
+        }
+      });
+      hEl.replaceChildren(t);
     }
     const brain = rep.brain || {};
     const plans = brain.recent || [];

@@ -198,6 +198,17 @@ class MasterServicer(RpcService):
             wal_fn=lambda op, **fields: self._wal(op, **fields),
             dirty_fn=self._mark_dirty,
         )
+        # hardware health plane: join-time probe reports judged
+        # against the fleet median and each host's own persisted
+        # fingerprint — pass/quarantine/refuse at the rendezvous door,
+        # plus continuous in-band degradation detection feeding the
+        # diagnosis sweep (durable like brain plans: WAL + snapshot)
+        from dlrover_tpu.master.health import HostHealthManager
+
+        self.health = HostHealthManager(
+            wal_fn=lambda op, **fields: self._wal(op, **fields),
+            dirty_fn=self._mark_dirty,
+        )
         # runtime straggler/hang diagnosis over the merged telemetry
         # (per-host TimerRing phase gauges + step.end activity); checks
         # are pull-driven from heartbeats and diagnosis queries. The
@@ -215,6 +226,7 @@ class MasterServicer(RpcService):
             ),
             brain=self.brain,
             capture=self.capture,
+            health=self.health,
         )
         # durable control-plane state (master failover); set by the
         # owning JobMaster when a state dir is configured
@@ -301,13 +313,27 @@ class MasterServicer(RpcService):
             mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
             stragglers, done = mgr.get_stragglers()
             diagnosed = self.diagnosis.stragglers()
-            nodes = sorted(set(stragglers) | set(diagnosed))
+            # third source (the TPU-side producer the merge path waited
+            # for since PR 6): hosts the health plane has quarantined
+            # or flagged as hw-degraded from probe timings
+            unhealthy = set(self.health.quarantined()) | set(
+                self.health.hw_degraded()
+            )
+            nodes = sorted(
+                set(stragglers) | set(diagnosed) | unhealthy
+            )
             blame = ";".join(
                 f"{rank}:{info.get('phase', '?')}"
                 for rank, info in sorted(diagnosed.items())
             )
+            if unhealthy:
+                hw_blame = ";".join(
+                    f"{rank}:hw" for rank in sorted(unhealthy)
+                )
+                blame = f"{blame};{hw_blame}" if blame else hw_blame
             return msg.NetworkCheckResult(
-                normal=done or bool(diagnosed), nodes=nodes,
+                normal=done or bool(diagnosed) or bool(unhealthy),
+                nodes=nodes,
                 reason=blame,
             )
         if isinstance(message, msg.PreemptNoticeRequest):
@@ -326,9 +352,18 @@ class MasterServicer(RpcService):
                 stragglers=verdicts["stragglers"],
                 hangs=verdicts["hangs"],
                 slo=verdicts.get("slo", {}),
+                hw=verdicts.get("hw", {}),
                 # the polling host's pending deep-capture directive
                 # (idempotent re-serve while it stands)
                 capture=self.capture.poll_directive(message.node_rank),
+            )
+        if isinstance(message, msg.NodeHealthRequest):
+            # a parked host polling why its (acked) join never formed a
+            # world: pass = round still filling, keep polling the comm
+            # world; quarantine/refuse = sleep retry_after_s, re-probe,
+            # re-join with the fresh report
+            return msg.NodeHealthVerdict(
+                **self.health.verdict(message.node_rank)
             )
         if isinstance(message, msg.ProfileCaptureRequest):
             return msg.ProfileCaptureAck(**self.capture.request(
@@ -521,6 +556,33 @@ class MasterServicer(RpcService):
             mgr = self.rdzv_managers.get(message.rdzv_name)
             if mgr is None:
                 return False
+            # health gate BEFORE the rendezvous manager sees the join:
+            # a quarantined/refused host never enters the waiting set,
+            # so it cannot flap a forming round. Ack True regardless —
+            # a False ack means "handler faulted, re-send join" to the
+            # agent; parked hosts learn their verdict (and backoff) by
+            # polling NodeHealthRequest instead.
+            gate = self.health.gate(
+                message.node_rank,
+                # older clients' pickles predate the probe field;
+                # an empty report passes the gate (old behavior)
+                getattr(message, "probe_report", None) or {},
+            )
+            if gate["verdict"] != "pass":
+                from dlrover_tpu.common import telemetry as _telemetry
+
+                _telemetry.event(
+                    "health." + gate["verdict"],
+                    rank=message.node_rank,
+                    reason=gate["reason"],
+                )
+                return True
+            if gate.get("cleared"):
+                from dlrover_tpu.common import telemetry as _telemetry
+
+                _telemetry.event(
+                    "health.readmit", rank=message.node_rank
+                )
             mgr.join_rendezvous(
                 message.node_rank,
                 message.local_world_size,
@@ -544,6 +606,12 @@ class MasterServicer(RpcService):
                 return False
             mgr.update_verified_steps(message.node_rank, message.steps)
             self._mark_dirty()
+            return True
+        if isinstance(message, msg.HostProbeReport):
+            # in-band re-probe from an admitted host: folds into the
+            # fingerprint store; sustained degradation surfaces on the
+            # next diagnosis sweep as a hw_degraded verdict
+            self.health.observe(message.node_rank, message.report)
             return True
         if isinstance(message, msg.NodeCheckResultRequest):
             mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)

@@ -250,6 +250,11 @@ class MasterStateStore:
             capture = getattr(self._servicer, "capture", None)
             if capture is not None:
                 state["captures"] = capture.export_state()
+            # hardware fingerprints + the quarantine waiting set: a
+            # failover mid-quarantine must re-serve the same verdict
+            health = getattr(self._servicer, "health", None)
+            if health is not None:
+                state["health"] = health.export_state()
         return state
 
     def write_snapshot(self) -> str | None:
@@ -374,6 +379,9 @@ class MasterStateStore:
             capture = getattr(self._servicer, "capture", None)
             if capture is not None and state.get("captures"):
                 capture.restore_state(state["captures"])
+            health = getattr(self._servicer, "health", None)
+            if health is not None and state.get("health"):
+                health.restore_state(state["health"])
 
     def _apply_wal_entry(self, e: dict, snapshot_applied: bool = True):
         op = e.get("op")
@@ -417,6 +425,12 @@ class MasterStateStore:
                 # id counter monotonic — over-replaying the tail
                 # around a snapshot boundary is a no-op
                 capture.replay(e["record"], next_id=e.get("next_id"))
+        elif op == "health" and self._servicer is not None:
+            health = getattr(self._servicer, "health", None)
+            if health is not None:
+                # absolute health state: upsert restore, so replaying
+                # the WAL tail around a snapshot boundary is a no-op
+                health.restore_state(e["state"])
         elif op == "kv" and self._kv_store is not None:
             self._kv_store.set(
                 e["key"], base64.b64decode(e["value"])

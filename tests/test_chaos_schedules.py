@@ -510,3 +510,38 @@ def test_targeted_restore_also_falls_back(_engine, tmp_path, disarm):
     np.testing.assert_array_equal(
         np.asarray(tree["w"]), np.full((4,), 4.0)
     )
+
+
+# -------------------------------------------------------------------------
+# e2e scenario 4: bad-host schedule -> health gate + drain + re-admit
+# -------------------------------------------------------------------------
+
+
+@pytest.mark.health
+def test_schedule_bad_host_gate_drain_readmit(
+    tmp_path, monkeypatch, disarm
+):
+    """The named bad-host schedule end-to-end via the harness's own
+    acceptance checks: the join-degraded host is refused at the door
+    (never enters a round), the mid-run degradation becomes an ``hw``
+    verdict and a brain drain+reshape with zero survivor restarts, the
+    standing verdict survives a master failover verbatim, and the
+    recovered host re-admits once its backoff re-probe comes back
+    clean. Also publishes the probe_join_overhead_s /
+    bad_host_quarantine_s bench keys and asserts the < 5 s join
+    budget."""
+    from tools.chaos_run import _run_bad_host
+
+    schedule = chaos.NAMED_SCHEDULES["bad-host"]
+    monkeypatch.setenv(chaos.ENV_VAR, json.dumps(schedule))
+    monkeypatch.setenv(
+        "DLROVER_TELEMETRY_DIR", str(tmp_path / "telemetry")
+    )
+    chaos.install(schedule)
+    assert _run_bad_host(schedule, str(tmp_path), steps=5) == 0
+    report = json.loads(
+        (tmp_path / "bad_host_report.json").read_text()
+    )
+    assert report["failures"] == []
+    assert report["keys"]["probe_join_overhead_s"] < 5.0
+    assert report["keys"]["bad_host_quarantine_s"] > 0

@@ -313,6 +313,55 @@ class TestChaosCoverage:
                 return subprocess.Popen(["master"])
         """, "chaos-coverage") == []
 
+    def test_probe_loopback_seams_covered_by_timed_window_site(
+        self, tmp_path
+    ):
+        """The health probe's shape: the chaos site fires INSIDE the
+        timed window (probe.degrade) and the socket helpers sit one
+        hop below it — within the hop budget, so agent/probe.py's
+        loopback seams stay chaos-coverable without a per-helper
+        site."""
+        assert lint_file(tmp_path, """
+            import socket
+
+            from dlrover_tpu.common.chaos import chaos_point
+
+            def collective_probe(rank):
+                server, sender, conn = _loopback_pair()
+                chaos_point("probe.degrade", leg="collective",
+                            rank=rank)
+                _loopback_rounds(sender, conn, 4)
+
+            def _loopback_pair():
+                server = socket.socket()
+                sender = socket.create_connection(("127.0.0.1", 1))
+                conn, _ = server.accept()
+                return server, sender, conn
+
+            def _loopback_rounds(sender, conn, rounds):
+                for _ in range(rounds):
+                    sender.sendall(b"x" * 8)
+                    conn.recv(8)
+        """, "chaos-coverage",
+            relpath="dlrover_tpu/agent/probe.py") == []
+
+    def test_uncovered_probe_socket_seam_flagged(self, tmp_path):
+        """A probe helper whose socket op no chaos site can reach is a
+        seam every schedule silently skips — flagged."""
+        found = lint_file(tmp_path, """
+            from dlrover_tpu.common.chaos import chaos_point
+
+            def run_probe(rank):
+                chaos_point("probe.degrade", leg="hbm", rank=rank)
+
+            def _side_channel(conn):
+                return conn.recv(4)
+        """, "chaos-coverage",
+            relpath="dlrover_tpu/agent/probe.py")
+        assert len(found) == 1
+        assert found[0].code == "DL003"
+        assert "socket op" in found[0].message
+
 
 # ---------------------------------------------------------------- DL004
 

@@ -644,6 +644,115 @@ class TestBrainPlanDurability:
         assert d2["plan_id"] != d1["plan_id"]
 
 
+@pytest.mark.health
+class TestHealthQuarantineDurability:
+    """A master killed with a host parked at the health gate restarts
+    from snapshot/WAL and re-serves the IDENTICAL standing verdict —
+    the quarantined host cannot launder its way in through a failover,
+    and the fleet fingerprints it is judged against survive too."""
+
+    @staticmethod
+    def _probe_report(**legs):
+        base = {"hbm": 100.0, "matmul": 100.0, "collective": 100.0}
+        base.update(legs)
+        return {"legs": base, "elapsed_s": 0.1, "error": ""}
+
+    def _gate_fleet_and_park_one(self, servicer):
+        import dlrover_tpu.common.messages as msg
+
+        for r in range(3):
+            assert servicer.report(
+                "worker", r, msg.JoinRendezvousRequest(
+                    node_id=r, node_rank=r, local_world_size=1,
+                    rdzv_name=RendezvousName.ELASTIC_TRAINING,
+                    probe_report=self._probe_report(),
+                )
+            )
+        assert servicer.report(
+            "worker", 3, msg.JoinRendezvousRequest(
+                node_id=3, node_rank=3, local_world_size=1,
+                rdzv_name=RendezvousName.ELASTIC_TRAINING,
+                probe_report=self._probe_report(hbm=450.0),
+            )
+        )
+
+    @pytest.mark.parametrize("with_snapshot", [True, False])
+    def test_failover_reserves_standing_verdict(
+        self, tmp_path, with_snapshot
+    ):
+        import dlrover_tpu.common.messages as msg
+
+        servicer = _build_master_parts()
+        store = _bind_store(servicer, tmp_path)
+        servicer.rdzv_managers[
+            RendezvousName.ELASTIC_TRAINING
+        ].update_rdzv_params(3, 8, 0.0, 1)
+        self._gate_fleet_and_park_one(servicer)
+        parked = servicer.get(
+            "worker", 3, msg.NodeHealthRequest(node_rank=3)
+        )
+        assert parked.verdict in ("quarantine", "refuse")
+        # ... the master dies HERE. WAL-only or snapshot+WAL:
+        if with_snapshot:
+            store.write_snapshot()
+
+        servicer2 = _build_master_parts()
+        store2 = _bind_store(servicer2, tmp_path)
+        assert store2.restore()
+        again = servicer2.get(
+            "worker", 3, msg.NodeHealthRequest(node_rank=3)
+        )
+        assert again.verdict == parked.verdict
+        assert again.strikes == parked.strikes
+        assert 3 in servicer2.health.quarantined()
+        restored = servicer2.health.quarantined()[3]
+        original = servicer.health.quarantined()[3]
+        assert restored["reason"] == original["reason"]
+        assert restored["until"] == original["until"]
+        # fingerprints rode along: the restored gate judges against
+        # the same fleet baseline, so the doomed host's re-join is
+        # re-refused on the merits too (after its backoff)
+        assert (
+            servicer2.health.summary()["hosts"]["0"]["legs"]
+            == servicer.health.summary()["hosts"]["0"]["legs"]
+        )
+        gate2 = servicer2.health.gate(
+            3, self._probe_report(hbm=450.0),
+            now=original["until"] + 1.0,
+        )
+        assert gate2["verdict"] in ("quarantine", "refuse")
+        assert gate2["strikes"] == parked.strikes + 1
+
+    def test_degradation_streak_survives_failover(self, tmp_path):
+        """The in-band persistence streak is state too: a failover in
+        the middle of the debounce window must not give a degrading
+        host a fresh set of free observations."""
+        import dlrover_tpu.common.messages as msg
+
+        servicer = _build_master_parts()
+        _bind_store(servicer, tmp_path)
+        servicer.rdzv_managers[
+            RendezvousName.ELASTIC_TRAINING
+        ].update_rdzv_params(3, 8, 0.0, 1)
+        for r in range(3):
+            servicer.health.gate(r, self._probe_report(), now=0.0)
+        for i in range(2):
+            servicer.health.observe(
+                1, self._probe_report(collective=350.0), now=float(i)
+            )
+        assert servicer.health.hw_degraded() == {}
+
+        servicer2 = _build_master_parts()
+        store2 = _bind_store(servicer2, tmp_path)
+        assert store2.restore()
+        assert servicer2.report("worker", 1, msg.HostProbeReport(
+            node_rank=1,
+            report=self._probe_report(collective=350.0),
+        ))
+        assert 1 in servicer2.health.hw_degraded()
+        assert servicer2.health.hw_degraded()[1]["streak"] == 3
+
+
 class TestVerifiedStepsReport:
     def test_refresh_without_dissolving_the_round(self, local_master):
         from dlrover_tpu.agent.master_client import MasterClient

@@ -58,6 +58,9 @@ HEADLINE_KEYS = {
     # request -> parsed-artifact deep-capture round trip
     "profile_sample_overhead_pct": "lower",
     "capture_roundtrip_s": "lower",
+    # health plane (tools/chaos_run.py bad-host arm)
+    "probe_join_overhead_s": "lower",
+    "bad_host_quarantine_s": "lower",
 }
 
 

@@ -1238,6 +1238,199 @@ def _run_serve_kill(schedule: dict, out_dir: str, steps: int) -> int:
     return 1 if failures else 0
 
 
+def _run_bad_host(schedule: dict, out_dir: str, steps: int) -> int:
+    """The health-plane proof, in-process: real probes (host stand-in
+    legs) against a real servicer, with the armed schedule degrading
+    host 3's join-time probe and host 1's in-band re-probes.
+
+    Asserts the full sense->gate->act loop: (1) the degraded host is
+    refused at the door — it never enters a round; (2) a mid-run
+    degradation becomes a ``diagnosis.hw_degraded`` verdict and a
+    brain drain+reshape with ZERO survivor restarts; (3) the verdict
+    survives a master failover; (4) the recovered host re-admits after
+    its backoff re-probe comes back clean. Publishes the
+    probe_join_overhead_s / bad_host_quarantine_s headline keys."""
+    from dlrover_tpu.agent.probe import run_probe
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.common.constants import RendezvousName
+
+    def build_master(state_dir: str):
+        from dlrover_tpu.master.state_store import MasterStateStore
+
+        servicer = _build_serving_master()
+        # harness-speed backoff: seconds, not the production 30 s
+        servicer.health._backoff = 0.3
+        servicer.health._backoff_cap = 5.0
+        store = MasterStateStore(state_dir)
+        store.bind(
+            task_manager=servicer.task_manager,
+            rdzv_managers=servicer.rdzv_managers,
+            kv_store=servicer.kv_store,
+            sync_service=servicer.sync_service,
+            servicer=servicer,
+            port=0,
+        )
+        servicer.state_store = store
+        return servicer, store
+
+    def join(servicer, rank: int, report: dict) -> bool:
+        return bool(servicer.report(
+            "worker", rank, msg.JoinRendezvousRequest(
+                node_id=rank, node_rank=rank, local_world_size=1,
+                rdzv_name=RendezvousName.ELASTIC_TRAINING,
+                node_ip="", probe_report=report,
+            )
+        ))
+
+    def health_of(servicer, rank: int):
+        return servicer.get(
+            "worker", rank, msg.NodeHealthRequest(node_rank=rank)
+        )
+
+    def world_of(servicer, rank: int) -> dict:
+        w = servicer.get("worker", rank, msg.CommWorldRequest(
+            node_id=rank, rdzv_name=RendezvousName.ELASTIC_TRAINING,
+        ))
+        return dict(w.world or {})
+
+    failures: list[str] = []
+    state_dir = os.path.join(out_dir, "master_state")
+    servicer, store = build_master(state_dir)
+    elastic = servicer.rdzv_managers[RendezvousName.ELASTIC_TRAINING]
+    elastic.update_rdzv_params(3, 3, 0.0, 1)
+
+    # ---- phase 1: the degraded host is refused at the door ----------
+    reports = {r: run_probe(r) for r in (0, 1, 2)}
+    probe_join_overhead_s = max(
+        r["elapsed_s"] for r in reports.values()
+    )
+    for r in (0, 1, 2):
+        join(servicer, r, reports[r])
+    join(servicer, 3, run_probe(3))  # chaos-degraded legs
+    world = world_of(servicer, 0)
+    print(f"phase 1: world={sorted(world)}  "
+          f"host 3: {health_of(servicer, 3)}")
+    if sorted(world) != [0, 1, 2]:
+        failures.append(f"expected world {{0,1,2}}, got {sorted(world)}")
+    verdict3 = health_of(servicer, 3)
+    if verdict3.verdict not in ("refuse", "quarantine"):
+        failures.append(
+            f"degraded host 3 was not parked (got {verdict3.verdict!r})"
+        )
+    if 3 in world_of(servicer, 3):
+        failures.append("degraded host 3 entered the round")
+    if probe_join_overhead_s >= 5.0:
+        failures.append(
+            f"join probe cost {probe_join_overhead_s:.2f}s on the "
+            f"CPU smoke arm (budget 5s)"
+        )
+
+    # ---- phase 2: mid-run degradation -> hw verdict -> drain --------
+    elastic.update_rdzv_params(2, 3, 0.0, 1)
+    t_q0 = time.monotonic()
+    for _ in range(3):  # the health manager's persistence streak
+        servicer.report("worker", 1, msg.HostProbeReport(
+            node_rank=1, report=run_probe(1),  # chaos-degraded now
+        ))
+    verdicts = servicer.diagnosis.check(force=True)
+    hw = verdicts.get("hw", {})
+    if 1 not in hw:
+        failures.append(f"no hw_degraded verdict for host 1 (got {hw})")
+    deadline = time.time() + 30
+    world = {}
+    while time.time() < deadline:
+        world = world_of(servicer, 0)
+        if sorted(world) == [0, 2]:
+            break
+        servicer.diagnosis.check(force=True)
+        time.sleep(0.05)
+    bad_host_quarantine_s = time.monotonic() - t_q0
+    round_ = elastic.rdzv_round()
+    member_verdicts, departed = elastic.round_verdicts(round_)
+    print(f"phase 2: world={sorted(world)} verdicts={member_verdicts} "
+          f"departed={departed} hw={hw} "
+          f"({bad_host_quarantine_s:.2f}s)")
+    if sorted(world) != [0, 2]:
+        failures.append(
+            f"drain+reshape never re-formed {{0,2}} (got {sorted(world)})"
+        )
+    if departed.get(1) != "drained":
+        failures.append(
+            f"host 1 should depart as drained, got {departed}"
+        )
+    restarted = [r for r, v in member_verdicts.items() if v != "reshape"]
+    if restarted:
+        failures.append(
+            f"survivors {restarted} got restart verdicts — reshape-"
+            f"first was violated"
+        )
+
+    # ---- phase 3: the quarantine verdict survives a failover --------
+    store.write_snapshot()
+    servicer2, store2 = build_master(state_dir)
+    store2.restore()
+    elastic2 = servicer2.rdzv_managers[
+        RendezvousName.ELASTIC_TRAINING
+    ]
+    restored3 = health_of(servicer2, 3)
+    print(f"phase 3: restored verdict for host 3: {restored3}")
+    if (restored3.verdict, restored3.reason, restored3.strikes) != (
+        verdict3.verdict, verdict3.reason, verdict3.strikes
+    ):
+        failures.append(
+            f"failover changed host 3's verdict: "
+            f"{verdict3} -> {restored3}"
+        )
+
+    # ---- phase 4: the recovered host re-admits after backoff --------
+    elastic2.update_rdzv_params(3, 3, 0.0, 1)
+    for r in (0, 2):
+        join(servicer2, r, run_probe(r))
+    admitted = False
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        verdict = health_of(servicer2, 3)
+        if verdict.verdict in ("pass", "unknown"):
+            admitted = True
+            break
+        # wait out the backoff, then re-join with a FRESH probe —
+        # exactly the agent's quarantine loop (the chaos rule's fire
+        # budget runs dry, so a later probe comes back clean)
+        time.sleep(max(verdict.retry_after_s, 0.05))
+        join(servicer2, 3, run_probe(3))
+        if health_of(servicer2, 3).verdict == "pass":
+            admitted = True
+            break
+    world = world_of(servicer2, 3)
+    print(f"phase 4: admitted={admitted} world={sorted(world)}")
+    if not admitted:
+        failures.append(
+            "recovered host 3 never re-admitted after backoff re-probe"
+        )
+    if sorted(world) != [0, 2, 3]:
+        failures.append(
+            f"re-admitted world should be {{0,2,3}}, got {sorted(world)}"
+        )
+
+    keys = {
+        "probe_join_overhead_s": round(probe_join_overhead_s, 4),
+        "bad_host_quarantine_s": round(bad_host_quarantine_s, 3),
+    }
+    result = {
+        "keys": keys,
+        "health": servicer2.health.summary(),
+        "failures": failures,
+    }
+    with open(os.path.join(out_dir, "bad_host_report.json"), "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"bench keys: {json.dumps(keys)}")
+    for f_ in failures:
+        print(f"FAIL: {f_}")
+    if not failures:
+        print("bad-host: PASS")
+    return 1 if failures else 0
+
+
 def _run_week(schedule: dict, out_dir: str, steps: int) -> int:
     """The week-in-the-life proof: the SAME seed brain-on and
     brain-off. Announced preemption, hard kill, persistent straggler,
@@ -1408,7 +1601,12 @@ def main() -> int:
         # the serving harness runs master + decode pool in THIS process
         "serve.step", "serve.admit",
     }
-    if any(r.get("site") in agent_sites for r in schedule.get("rules", [])):
+    if any(
+        r.get("site") in agent_sites
+        # the health-plane harness runs its probes in THIS process
+        or str(r.get("site", "")).startswith("probe.")
+        for r in schedule.get("rules", [])
+    ):
         chaos.install(schedule)
 
     if any(
@@ -1425,6 +1623,13 @@ def main() -> int:
         # serving harness: in-process master + decode pool under a
         # Poisson sweep, one worker chaos-killed mid-flight
         rc = _run_serve_kill(schedule, out_dir, args.steps)
+    elif any(
+        str(r.get("site", "")).startswith("probe.")
+        for r in schedule.get("rules", [])
+    ):
+        # health-plane harness: in-process master, real probes, the
+        # schedule degrading one host at the door and one mid-run
+        rc = _run_bad_host(schedule, out_dir, args.steps)
     elif any(
         r.get("site") == "master.kill"
         for r in schedule.get("rules", [])

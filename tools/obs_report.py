@@ -81,6 +81,7 @@ def build_report(
     report["profiling"] = _profiling_summary(
         report.get("metrics", {}), report.get("timeline", [])
     )
+    report["health"] = _health_summary(report.get("timeline", []))
     if trace_dir:
         try:
             from tools.parse_profile import summarize
@@ -279,6 +280,62 @@ def _profiling_summary(metrics: dict, timeline: list) -> dict:
     if not out and not events:
         return {}
     return {"metrics": out, "events": events}
+
+
+def _health_summary(timeline: list) -> dict:
+    """The hardware health plane from the timeline: per-host standing
+    verdict replayed from ``health.quarantine`` / ``health.refuse`` /
+    ``health.readmit`` gate events plus ``diagnosis.hw_degraded``
+    verdicts — the offline twin of the dashboard's host-health panel
+    (live fingerprints/sparklines ride ``/report.json`` instead)."""
+    standing: dict[int, dict] = {}
+    events = []
+    for ev in timeline:
+        kind = str(ev.get("kind", ""))
+        if not kind.startswith(("health.", "diagnosis.hw_degraded")):
+            continue
+        rank = ev.get("rank")
+        events.append({
+            "t": ev.get("t"), "kind": kind, "rank": rank,
+            "reason": ev.get("reason") or ev.get("leg"),
+        })
+        if rank is None:
+            continue
+        rank = int(rank)
+        if kind in ("health.quarantine", "health.refuse"):
+            standing[rank] = {
+                "verdict": kind.split(".", 1)[1],
+                "reason": ev.get("reason", ""),
+            }
+        elif kind == "health.readmit":
+            standing.pop(rank, None)
+    if not standing and not events:
+        return {}
+    return {"quarantined": standing, "events": events[-16:]}
+
+
+def warn_hosts_quarantined(report: dict, out=None) -> bool:
+    """LOUD banner when any host stands quarantined/refused at the
+    health gate: the job is running without it, and a report that
+    buries that reads as a healthy fleet. Returns True when it
+    fired."""
+    standing = (report.get("health") or {}).get("quarantined") or {}
+    if not standing:
+        return False
+    out = sys.stderr if out is None else out
+    print("!" * 66, file=out)
+    print(
+        "!! WARNING: host(s) parked at the hardware health gate "
+        "(probe\n!! timings vs fleet/own baseline) — the job is "
+        "running without:", file=out,
+    )
+    for rank, info in sorted(standing.items()):
+        print(
+            f"!!   host {rank}: {info['verdict']} ({info['reason']})",
+            file=out,
+        )
+    print("!" * 66, file=out)
+    return True
 
 
 def _restore_summary(metrics: dict) -> dict:
@@ -509,6 +566,7 @@ def live_loop(master_addr: str, interval: float = 2.0,
                 print("\033[H\033[2J", end="", file=out)
             print(frame, file=out, flush=True)
             warn_events_dropped(report)
+            warn_hosts_quarantined(report)
             if iterations is None or n < iterations:
                 time.sleep(interval)
     except (KeyboardInterrupt, BrokenPipeError):
@@ -598,6 +656,7 @@ def main(argv=None) -> int:
         print("no telemetry snapshots found", file=sys.stderr)
         return 1
     warn_events_dropped(report)
+    warn_hosts_quarantined(report)
     if args.perfetto:
         path = write_perfetto(
             report, args.perfetto, trace_dir=args.trace_dir,
@@ -661,6 +720,20 @@ def main(argv=None) -> int:
                     if k not in ("t", "kind") and v is not None
                 )
                 print(f"  {ev['kind']:<28} {extra}")
+        health = report.get("health") or {}
+        if health:
+            print("\n=== host health (probe gate) ===")
+            for rank, info in sorted(
+                (health.get("quarantined") or {}).items()
+            ):
+                print(f"  host {rank}: {info['verdict']} "
+                      f"({info['reason']})")
+            for ev in health.get("events") or []:
+                extra = " ".join(
+                    f"{k}={v}" for k, v in ev.items()
+                    if k not in ("t", "kind") and v is not None
+                )
+                print(f"  {ev['kind']:<24} {extra}")
         control = report.get("control_plane") or {}
         if control:
             print("\n=== control plane (master RPC surface) ===")
